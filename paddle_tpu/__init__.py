@@ -1,0 +1,48 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle Fluid's
+capabilities (reference: zhangting2020/Paddle, see SURVEY.md).
+
+Public surface mirrors ``paddle.fluid``: a Program/Block/Op IR built by a layers DSL,
+program-level autodiff, optimizers, executors -- but Programs lower whole to XLA,
+parallelism is SPMD sharding over device meshes, and custom kernels are Pallas.
+"""
+
+from . import unique_name  # noqa: F401
+from .framework import (Program, Block, Variable, Parameter, Operator,  # noqa
+                        program_guard, default_main_program,
+                        default_startup_program, switch_main_program,
+                        grad_var_name, convert_dtype)
+from . import ops  # noqa: F401  (registers the op library)
+from .core.executor import Executor, Scope, global_scope, scope_guard  # noqa
+from .core.backward import append_backward, gradients, calc_gradient  # noqa
+from .core import registry  # noqa: F401
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from .layer_helper import LayerHelper, ParamAttr, WeightNormParamAttr  # noqa
+from .layers.io import data  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+class CPUPlace:
+    """Place tags kept for fluid API parity; device selection is JAX's."""
+
+
+class CUDAPlace:
+    def __init__(self, id=0):
+        self.id = id
+
+
+class TPUPlace:
+    def __init__(self, id=0):
+        self.id = id
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    return [CUDAPlace(0)]

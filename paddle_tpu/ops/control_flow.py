@@ -1,0 +1,118 @@
+"""Control-flow ops (reference: paddle/fluid/operators/controlflow/:
+conditional_block_op, while_op; recurrent_op).
+
+TPU-native: sub-blocks lower through ``ctx.block_runner`` into lax.while_loop /
+lax.cond -- XLA-compilable structured control flow instead of the reference's
+sub-scope interpreter recursion. Static shapes are required: loop-carried vars must
+keep their shapes across iterations.
+"""
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+@register("while", grad=None)
+def while_op(ctx, ins):
+    """attrs: sub_block (int), loop_vars (list of names carried), cond (name).
+
+    The sub-block must rewrite the condition var and the loop vars each iteration.
+    """
+    import jax
+
+    sub_idx = ctx.attr("sub_block")
+    carried = list(ctx.attr("loop_vars", []))
+    cond_name = ctx.attr("cond_name")
+    xs = ins["X"]
+    x_names = ctx.attr("x_names", [])
+    env0 = dict(zip(x_names, xs))
+
+    def cond_fn(env):
+        return env[cond_name].reshape(())
+
+    def body_fn(env):
+        new_env = dict(env)
+        new_env = ctx.block_runner(sub_idx, new_env)
+        return {k: new_env[k] for k in env}
+
+    env_final = jax.lax.while_loop(cond_fn, body_fn, env0)
+    return {"Out": [env_final[n] for n in ctx.attr("out_names", [])]}
+
+
+@register("conditional_block", grad=None)
+def conditional_block(ctx, ins):
+    import jax
+
+    sub_idx = ctx.attr("sub_block")
+    else_idx = ctx.attr("else_block", -1)
+    cond = ins["Cond"][0].reshape(())
+    x_names = ctx.attr("x_names", [])
+    out_names = ctx.attr("out_names", [])
+    env0 = dict(zip(x_names, ins["X"]))
+
+    def then_fn(env):
+        e = ctx.block_runner(sub_idx, dict(env))
+        return [e[n] for n in out_names]
+
+    def else_fn(env):
+        if else_idx >= 0:
+            e = ctx.block_runner(else_idx, dict(env))
+            return [e[n] for n in out_names]
+        return [env[n] for n in out_names]
+
+    outs = jax.lax.cond(cond, then_fn, else_fn, env0)
+    return {"Out": list(outs)}
+
+
+@register("scan", grad="auto")
+def scan_op(ctx, ins):
+    """Structured recurrence: the TPU-native replacement for recurrent_op/DynamicRNN.
+
+    attrs: sub_block, carry_names (loop state), x_names (per-step inputs scanned over
+    the time axis), out_names (per-step outputs stacked), time_major.
+    Inputs: Init (initial carries, ordered as carry_names), X (sequences [T, ...] or
+    [B, T, ...]).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sub_idx = ctx.attr("sub_block")
+    carry_names = list(ctx.attr("carry_names", []))
+    x_names = list(ctx.attr("x_names", []))
+    out_names = list(ctx.attr("out_names", []))
+    time_major = ctx.attr("time_major", False)
+
+    init = dict(zip(carry_names, ins["Init"]))
+    seqs = ins.get("X", [])
+    seq_env = {}
+    for n, s in zip(x_names, seqs):
+        seq_env[n] = s if time_major else jnp.swapaxes(s, 0, 1)
+
+    def body(carry, xt):
+        env = dict(carry)
+        env.update(xt)
+        env = ctx.block_runner(sub_idx, env)
+        new_carry = {k: env[k] for k in carry_names}
+        outs = {k: env[k] for k in out_names}
+        return new_carry, outs
+
+    final_carry, stacked = jax.lax.scan(body, init, seq_env)
+    outs = []
+    for n in out_names:
+        o = stacked[n]
+        outs.append(o if time_major else jnp.swapaxes(o, 0, 1))
+    return {"Out": outs, "FinalCarry": [final_carry[n] for n in carry_names]}
+
+
+@register("print", grad="auto")
+def print_op(ctx, ins):
+    """Debug print (reference print_op.cc / lodtensor_printer): host callback."""
+    import jax
+    x = ins["In"][0]
+    msg = ctx.attr("message", "")
+    jax.debug.print(msg + "{x}", x=x)
+    return {"Out": [x]}
+
+
+@register("assert", grad=None)
+def assert_op(ctx, ins):
+    return {}

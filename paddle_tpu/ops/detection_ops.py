@@ -1,0 +1,139 @@
+"""Detection ops (reference: paddle/fluid/operators/detection/, 15.4k LoC).
+
+Round-1 subset: box_coder, prior_box, yolo_box, iou_similarity. The NMS family needs
+dynamic shapes; a TPU-friendly fixed-size top-k NMS is planned (see SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("iou_similarity", grad=None)
+def iou_similarity(ctx, ins):
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]  # [N,4], [M,4] xyxy
+    area = lambda b: jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    ax, ay = area(x), area(y)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": [inter / (ax[:, None] + ay[None, :] - inter + 1e-10)]}
+
+
+@register("box_coder", grad=None)
+def box_coder(ctx, ins):
+    jnp = _jnp()
+    prior = ins["PriorBox"][0]  # [M,4]
+    target = ins["TargetBox"][0]
+    code_type = ctx.attr("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+    else:
+        t = target.reshape(-1, prior.shape[0], 4)
+        ocx = pcx + t[..., 0] * pw
+        ocy = pcy + t[..., 1] * ph
+        ow = jnp.exp(t[..., 2]) * pw
+        oh = jnp.exp(t[..., 3]) * ph
+        out = jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh,
+                         ocx + 0.5 * ow, ocy + 0.5 * oh], axis=-1)
+    return {"OutputBox": [out]}
+
+
+@register("prior_box", grad=None)
+def prior_box(ctx, ins):
+    jnp = _jnp()
+    x = ins["Input"][0]      # feature map [N,C,H,W]
+    img = ins["Image"][0]    # [N,C,IH,IW]
+    min_sizes = ctx.attr("min_sizes", [])
+    max_sizes = ctx.attr("max_sizes", [])
+    ars = ctx.attr("aspect_ratios", [1.0])
+    flip = ctx.attr("flip", False)
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    offset = ctx.attr("offset", 0.5)
+    H, W = x.shape[2], x.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+    full_ars = []
+    for ar in ars:
+        full_ars.append(ar)
+        if flip and ar != 1.0:
+            full_ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        sizes = [(ms, ms)]
+        for ar in full_ars:
+            if ar == 1.0:
+                continue
+            sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            sizes.insert(1, (np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        boxes.extend(sizes)
+    cx = (jnp.arange(W) + offset) * sw
+    cy = (jnp.arange(H) + offset) * sh
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([(cxg - bw / 2) / IW, (cyg - bh / 2) / IH,
+                              (cxg + bw / 2) / IW, (cyg + bh / 2) / IH], axis=-1))
+    priors = jnp.stack(out, axis=2)  # [H, W, nb, 4]
+    if ctx.attr("clip", False):
+        priors = jnp.clip(priors, 0.0, 1.0)
+    var = jnp.asarray(ctx.attr("variances", [0.1, 0.1, 0.2, 0.2]), "float32")
+    variances = jnp.broadcast_to(var, priors.shape)
+    return {"Boxes": [priors], "Variances": [variances]}
+
+
+@register("yolo_box", grad=None)
+def yolo_box(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]          # [N, an*(5+cls), H, W]
+    imgsize = ins["ImgSize"][0]
+    anchors = ctx.attr("anchors", [])
+    class_num = ctx.attr("class_num")
+    conf_thresh = ctx.attr("conf_thresh", 0.01)
+    downsample = ctx.attr("downsample_ratio", 32)
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    import jax
+    sig = jax.nn.sigmoid
+    gx = (jnp.arange(w)[None, None, None, :] + sig(x[:, :, 0])) / w
+    gy = (jnp.arange(h)[None, None, :, None] + sig(x[:, :, 1])) / h
+    aw = jnp.asarray(anchors[0::2], "float32").reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2], "float32").reshape(1, na, 1, 1)
+    in_w, in_h = w * downsample, h * downsample
+    bw = jnp.exp(x[:, :, 2]) * aw / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah / in_h
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+    mask = (conf > conf_thresh).astype(x.dtype)
+    img_h = imgsize[:, 0].reshape(n, 1, 1, 1).astype(x.dtype)
+    img_w = imgsize[:, 1].reshape(n, 1, 1, 1).astype(x.dtype)
+    boxes = jnp.stack([(gx - bw / 2) * img_w, (gy - bh / 2) * img_h,
+                       (gx + bw / 2) * img_w, (gy + bh / 2) * img_h], axis=-1)
+    boxes = boxes * mask[..., None]
+    boxes = boxes.reshape(n, -1, 4)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2).reshape(
+        n, -1, class_num)
+    return {"Boxes": [boxes], "Scores": [scores]}

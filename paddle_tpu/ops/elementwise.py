@@ -1,0 +1,52 @@
+"""Broadcastable binary elementwise ops with Fluid ``axis`` semantics.
+
+Reference: paddle/fluid/operators/elementwise/ (~5.9k LoC). Fluid broadcast rule:
+Y's shape must match a contiguous dim-run of X starting at ``axis`` (default: trailing
+alignment, axis = x.ndim - y.ndim); Y is reshaped to x.ndim with singleton dims outside
+the run, then numpy-broadcast. Gradients reduce back over broadcast dims via the
+generic vjp (jax handles the sum-over-broadcast automatically).
+"""
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _broadcast_y(x, y, axis):
+    import jax.numpy as jnp
+    if x.shape == y.shape or y.ndim == 0:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    yshape = list(y.shape)
+    # fluid allows trailing singleton dims on Y beyond the matched run (e.g. X [2,3,4],
+    # Y [3,1] with axis=1 means Y is really [3])
+    while len(yshape) > 1 and yshape[-1] == 1 and axis + len(yshape) > x.ndim:
+        yshape.pop()
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return jnp.reshape(y, new_shape)
+
+
+def _binary(name, fn):
+    @register(name)
+    def lower(ctx, ins, fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return lower
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_binary("elementwise_add", lambda x, y: x + y)
+_binary("elementwise_sub", lambda x, y: x - y)
+_binary("elementwise_mul", lambda x, y: x * y)
+_binary("elementwise_div", lambda x, y: x / y)
+_binary("elementwise_min", lambda x, y: _jnp().minimum(x, y))
+_binary("elementwise_max", lambda x, y: _jnp().maximum(x, y))
+_binary("elementwise_pow", lambda x, y: _jnp().power(x, y))
+_binary("elementwise_mod", lambda x, y: _jnp().mod(x, y))
+_binary("elementwise_floordiv", lambda x, y: _jnp().floor_divide(x, y))

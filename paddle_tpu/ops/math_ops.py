@@ -1,0 +1,218 @@
+"""Matmul family + softmax/cross-entropy + norms.
+
+Reference: paddle/fluid/operators/{matmul_op, mul_op, softmax_op,
+softmax_with_cross_entropy_op, cross_entropy_op, log_softmax}.* and math/blas.h.
+Matmuls are the MXU path: lowerings keep them as single large dots (no scalar loops),
+letting XLA tile onto the systolic array; bf16 flows through unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("matmul")
+def matmul(ctx, ins):
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * np.asarray(alpha, dtype=out.dtype)
+    return {"Out": [out]}
+
+
+@register("mul")
+def mul(ctx, ins):
+    """Flattening matmul (reference mul_op.cc): X flattened to 2D at x_num_col_dims."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = ctx.attr("x_num_col_dims", 1)
+    yn = ctx.attr("y_num_col_dims", 1)
+    xlead = x.shape[:xn]
+    x2 = x.reshape((int(np.prod(xlead or (1,))), -1))
+    y2 = y.reshape((int(np.prod(y.shape[:yn] or (1,))), -1))
+    out = x2 @ y2
+    return {"Out": [out.reshape(tuple(xlead) + tuple(y.shape[yn:]))]}
+
+
+@register("bmm")
+def bmm(ctx, ins):
+    return {"Out": [_jnp().matmul(ins["X"][0], ins["Y"][0])]}
+
+
+@register("dot")
+def dot(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.sum(ins["X"][0] * ins["Y"][0], axis=-1, keepdims=True)]}
+
+
+@register("softmax")
+def softmax(ctx, ins):
+    import jax
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=ctx.attr("axis", -1))]}
+
+
+@register("log_softmax")
+def log_softmax(ctx, ins):
+    import jax
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=ctx.attr("axis", -1))]}
+
+
+@register("softmax_with_cross_entropy", nondiff_inputs=("Label",),
+          nondiff_outputs=("Softmax",))
+def softmax_with_cross_entropy(ctx, ins):
+    """Fused stable softmax + CE (reference softmax_with_cross_entropy_op.cc).
+
+    Hard labels: Label int [N...,1]; soft labels: Label same shape as Logits.
+    Outputs: Softmax (no grad flow), Loss [N...,1].
+    NOTE: Softmax marked nondiff so the vjp grad comes only from Loss -- matching the
+    reference, whose grad kernel uses only the saved Softmax.
+    """
+    import jax
+    jnp = _jnp()
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = ctx.attr("axis", -1)
+    lse = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
+    log_probs = logits - lse
+    softmax_out = jnp.exp(log_probs)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label.astype(log_probs.dtype) * log_probs, axis=axis,
+                        keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        picked = jnp.take_along_axis(log_probs, lab[..., None].astype("int32"),
+                                     axis=axis)
+        loss = -picked
+        ignore = ctx.attr("ignore_index", -100)
+        if ignore >= 0:
+            mask = (lab[..., None] != ignore)
+            loss = jnp.where(mask, loss, jnp.zeros_like(loss))
+    return {"Softmax": [jax.lax.stop_gradient(softmax_out)], "Loss": [loss]}
+
+
+@register("cross_entropy", nondiff_inputs=("Label",))
+def cross_entropy(ctx, ins):
+    jnp = _jnp()
+    x, label = ins["X"][0], ins["Label"][0]
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label.astype(x.dtype) * jnp.log(x), axis=-1, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == x.ndim and lab.shape[-1] == 1:
+            lab = jnp.squeeze(lab, axis=-1)
+        picked = jnp.take_along_axis(x, lab[..., None].astype("int32"), axis=-1)
+        loss = -jnp.log(picked)
+        ignore = ctx.attr("ignore_index", -100)
+        if ignore >= 0:
+            loss = jnp.where(lab[..., None] != ignore, loss, jnp.zeros_like(loss))
+    return {"Y": [loss]}
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def sigmoid_ce(ctx, ins):
+    jnp = _jnp()
+    x, label = ins["X"][0], ins["Label"][0]
+    # stable: max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label.astype(x.dtype) + jnp.log1p(
+        jnp.exp(-jnp.abs(x)))
+    ignore = ctx.attr("ignore_index", -100)
+    if ignore >= 0:
+        loss = jnp.where(label != ignore, loss, jnp.zeros_like(loss))
+    if ctx.attr("normalize", False):
+        n = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / n
+    return {"Out": [loss]}
+
+
+@register("mean")
+def mean(ctx, ins):
+    return {"Out": [_jnp().mean(ins["X"][0]).reshape((1,))]}
+
+
+@register("huber_loss", nondiff_outputs=("Residual",))
+def huber_loss(ctx, ins):
+    import jax
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    d = ctx.attr("delta", 1.0)
+    r = y - x
+    loss = jnp.where(jnp.abs(r) <= d, 0.5 * r * r, d * (jnp.abs(r) - 0.5 * d))
+    return {"Out": [loss], "Residual": [jax.lax.stop_gradient(r)]}
+
+
+@register("square_error_cost")
+def square_error_cost(ctx, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y
+    return {"Out": [d * d]}
+
+
+@register("smooth_l1_loss", nondiff_outputs=("Diff",))
+def smooth_l1_loss(ctx, ins):
+    import jax
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if len(ins.get("InsideWeight", [])) and ins["InsideWeight"][0] is not None:
+        d = d * ins["InsideWeight"][0]
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    if len(ins.get("OutsideWeight", [])) and ins["OutsideWeight"][0] is not None:
+        loss = loss * ins["OutsideWeight"][0]
+    loss = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [loss], "Diff": [jax.lax.stop_gradient(d)]}
+
+
+@register("cos_sim")
+def cos_sim(ctx, ins):
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register("l2_normalize")
+def l2_normalize(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register("p_norm")
+def p_norm(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    p = ctx.attr("porder", 2.0)
+    axis = ctx.attr("axis", -1)
+    keepdim = ctx.attr("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return {"Out": [out]}
+
+
+@register("log_loss")
+def log_loss(ctx, ins):
+    jnp = _jnp()
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = ctx.attr("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}

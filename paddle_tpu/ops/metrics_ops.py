@@ -1,0 +1,55 @@
+"""In-graph metric ops (reference: paddle/fluid/operators/metrics/: accuracy_op,
+auc_op, precision_recall_op)."""
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("accuracy", grad=None, nondiff_inputs=("Out", "Indices", "Label"))
+def accuracy(ctx, ins):
+    """Top-k accuracy: Indices [N,k] from top_k, Label [N,1]."""
+    jnp = _jnp()
+    idx = ins["Indices"][0]
+    label = ins["Label"][0]
+    if label.ndim == 1:
+        label = label[:, None]
+    correct = jnp.any(idx == label.astype(idx.dtype), axis=1)
+    total = jnp.asarray(idx.shape[0], "float32")
+    ncorrect = jnp.sum(correct.astype("float32"))
+    return {"Accuracy": [(ncorrect / total).reshape((1,))],
+            "Correct": [ncorrect.astype("int32").reshape((1,))],
+            "Total": [jnp.asarray([idx.shape[0]], "int32")]}
+
+
+@register("auc", grad=None, nondiff_inputs=("Predict", "Label"))
+def auc(ctx, ins):
+    """Streaming AUC via fixed histogram buckets (reference auc_op.cc).
+
+    StatPos/StatNeg are persistable state vars threaded functionally.
+    """
+    jnp = _jnp()
+    pred = ins["Predict"][0]  # [N, 2] (prob of neg, pos)
+    label = ins["Label"][0].reshape(-1)
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    num_thresholds = ctx.attr("num_thresholds", 4095)
+    p = pred[:, -1]
+    bucket = jnp.clip((p * num_thresholds).astype("int32"), 0, num_thresholds)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    pos_out = stat_pos.at[bucket].add(is_pos)
+    neg_out = stat_neg.at[bucket].add(1 - is_pos)
+    # AUC = sum over buckets (descending threshold) of trapezoid areas
+    tp = jnp.cumsum(pos_out[::-1])
+    fp = jnp.cumsum(neg_out[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1)
+    fpr = fp / jnp.maximum(tot_neg, 1)
+    tpr0 = jnp.concatenate([jnp.zeros((1,), tpr.dtype), tpr[:-1]])
+    fpr0 = jnp.concatenate([jnp.zeros((1,), fpr.dtype), fpr[:-1]])
+    auc_val = jnp.sum((fpr - fpr0) * (tpr + tpr0) / 2.0)
+    return {"AUC": [auc_val.reshape((1,)).astype("float64")],
+            "StatPosOut": [pos_out], "StatNegOut": [neg_out]}

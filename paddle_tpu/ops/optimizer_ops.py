@@ -1,0 +1,244 @@
+"""Optimizer update ops (reference: paddle/fluid/operators/optimizers/, ~4.7k LoC).
+
+Each op functionally rewrites Param (and moments) -- outputs alias the input state vars
+by name, so under the executor's state threading + buffer donation XLA performs the
+update in place. All are grad=None (they sit after the backward section).
+
+The whole optimizer update for all params runs inside the same XLA program as
+forward/backward -- the reference's fuse_optimizer_ops_pass / coalesce_grad_tensor_pass
+(ir/fuse_optimizer_ops_pass/) exist to batch kernel launches, which XLA fusion already
+does, so there is nothing to fuse by hand here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _f(x, ref):
+    """Cast update math to f32 then back to the param dtype."""
+    return x.astype("float32")
+
+
+@register("sgd", grad=None)
+def sgd(ctx, ins):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [(p - lr.astype(p.dtype) * g.astype(p.dtype)).astype(p.dtype)]}
+
+
+@register("momentum", grad=None)
+def momentum(ctx, ins):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0].astype(p.dtype)
+    mu = np.float32(ctx.attr("mu", 0.9)).astype(p.dtype)
+    v_out = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register("lars_momentum", grad=None)
+def lars_momentum(ctx, ins):
+    jnp = _jnp()
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0]
+    mu = ctx.attr("mu", 0.9)
+    coeff = ctx.attr("lars_coeff", 0.001)
+    decay = ctx.attr("lars_weight_decay", 0.0005)
+    pn = jnp.sqrt(jnp.sum(p * p))
+    gn = jnp.sqrt(jnp.sum(g * g))
+    local_lr = jnp.where(pn > 0, lr * coeff * pn / (gn + decay * pn + 1e-12), lr)
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register("adam", grad=None)
+def adam(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    gf = g.astype("float32")
+    m_out = b1 * m + (1 - b1) * gf
+    v_out = b2 * v + (1 - b2) * gf * gf
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p.astype("float32") - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "Moment1Out": [m_out],
+            "Moment2Out": [v_out], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}
+
+
+@register("adamw", grad=None)
+def adamw(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    wd = ctx.attr("coeff", 0.01)
+    gf = g.astype("float32")
+    m_out = b1 * m + (1 - b1) * gf
+    v_out = b2 * v + (1 - b2) * gf * gf
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    pf = p.astype("float32")
+    p_out = pf - lr_t * m_out / (jnp.sqrt(v_out) + eps) - lr * wd * pf
+    return {"ParamOut": [p_out.astype(p.dtype)], "Moment1Out": [m_out],
+            "Moment2Out": [v_out], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}
+
+
+@register("adagrad", grad=None)
+def adagrad(ctx, ins):
+    jnp = _jnp()
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = mom + g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register("adamax", grad=None)
+def adamax(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p)) * m_out / (inf_out + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out]}
+
+
+@register("adadelta", grad=None)
+def adadelta(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    asg = rho * avg_sq_g + (1 - rho) * g * g
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_u + (1 - rho) * update * update
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [asg],
+            "AvgSquaredUpdateOut": [asu]}
+
+
+@register("rmsprop", grad=None)
+def rmsprop(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    eps = ctx.attr("epsilon", 1e-10)
+    decay = ctx.attr("decay", 0.9)
+    mu = ctx.attr("momentum", 0.0)
+    ms_out = decay * ms + (1 - decay) * g * g
+    if ctx.attr("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_out = decay * mg + (1 - decay) * g
+        mom_out = mu * mom + lr * g / jnp.sqrt(ms_out - mg_out * mg_out + eps)
+        return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+                "MomentOut": [mom_out], "MeanGradOut": [mg_out]}
+    mom_out = mu * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+            "MomentOut": [mom_out]}
+
+
+@register("ftrl", grad=None)
+def ftrl(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = ins["LearningRate"][0]
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    power = ctx.attr("lr_power", -0.5)
+    new_sq = sq + g * g
+    sigma = (new_sq ** -power - sq ** -power) / lr
+    lin_out = lin + g - sigma * p
+    x = jnp.clip(lin_out, -l1, l1) - lin_out
+    y = new_sq ** -power / lr + 2 * l2
+    p_out = x / y
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register("lamb", grad=None)
+def lamb(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-6)
+    wd = ctx.attr("weight_decay", 0.01)
+    gf = g.astype("float32")
+    pf = p.astype("float32")
+    m_out = b1 * m + (1 - b1) * gf
+    v_out = b2 * v + (1 - b2) * gf * gf
+    m_hat = m_out / (1 - b1p)
+    v_hat = v_out / (1 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * pf
+    p_norm = jnp.sqrt(jnp.sum(pf * pf))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_out = pf - lr * trust * r
+    return {"ParamOut": [p_out.astype(p.dtype)], "Moment1Out": [m_out],
+            "Moment2Out": [v_out], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}
+
+
+@register("dpsgd", grad=None)
+def dpsgd(ctx, ins):
+    import jax
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0]
+    clip = ctx.attr("clip", 10.0)
+    sigma = ctx.attr("sigma", 1.0)
+    gn = jnp.sqrt(jnp.sum(g * g))
+    g = g * jnp.minimum(1.0, clip / (gn + 1e-12))
+    noise = jax.random.normal(ctx.rng(), g.shape, dtype=g.dtype) * sigma * clip
+    return {"ParamOut": [p - lr * (g + noise)]}
+
+
+@register("proximal_gd", grad=None)
+def proximal_gd(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0]
+    l1, l2 = ctx.attr("l1", 0.0), ctx.attr("l2", 0.0)
+    prox = p - lr * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": [p_out]}
+
+
+@register("decayed_adagrad", grad=None)
+def decayed_adagrad(ctx, ins):
+    jnp = _jnp()
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = decay * mom + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_out) + eps)], "MomentOut": [m_out]}

@@ -1,0 +1,67 @@
+"""Reduction ops (reference: paddle/fluid/operators/reduce_ops/, shared reduce_op.h).
+
+Attrs follow the reference: ``dim`` (list of axes, may be negative), ``keep_dim``,
+``reduce_all``.
+"""
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _axes(ctx, x):
+    if ctx.attr("reduce_all", False):
+        return None
+    dim = ctx.attr("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % x.ndim for d in dim)
+
+
+def _reduce(name, fn, grad="auto"):
+    @register(name, grad=grad)
+    def lower(ctx, ins, fn=fn):
+        x = ins["X"][0]
+        return {"Out": [fn(x, _axes(ctx, x), ctx.attr("keep_dim", False))]}
+    return lower
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_reduce("reduce_sum", lambda x, a, k: _jnp().sum(x, axis=a, keepdims=k))
+_reduce("reduce_mean", lambda x, a, k: _jnp().mean(x, axis=a, keepdims=k))
+_reduce("reduce_max", lambda x, a, k: _jnp().max(x, axis=a, keepdims=k))
+_reduce("reduce_min", lambda x, a, k: _jnp().min(x, axis=a, keepdims=k))
+_reduce("reduce_prod", lambda x, a, k: _jnp().prod(x, axis=a, keepdims=k))
+_reduce("reduce_all", lambda x, a, k: _jnp().all(x, axis=a, keepdims=k), grad=None)
+_reduce("reduce_any", lambda x, a, k: _jnp().any(x, axis=a, keepdims=k), grad=None)
+
+
+@register("logsumexp")
+def logsumexp(ctx, ins):
+    import jax
+    x = ins["X"][0]
+    return {"Out": [jax.scipy.special.logsumexp(x, axis=_axes(ctx, x),
+                                                keepdims=ctx.attr("keep_dim", False))]}
+
+
+@register("cumsum")
+def cumsum(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", -1)
+    if ctx.attr("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if ctx.attr("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis % x.ndim] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis % x.ndim] = slice(0, x.shape[axis % x.ndim])
+        out = jnp.pad(out, pad)[tuple(sl)]
+    if ctx.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis=axis), axis=axis), axis=axis)
+    return {"Out": [out]}

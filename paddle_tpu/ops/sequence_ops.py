@@ -1,0 +1,117 @@
+"""Sequence ops on padded+mask representation.
+
+Reference: paddle/fluid/operators/sequence_ops/ (~5.8k LoC) operate on LoDTensors
+(ragged rows). TPU-native representation: dense padded [B, T, ...] tensors plus either
+an explicit length vector [B] or a mask -- static shapes for XLA (SURVEY.md §5.7).
+Each op takes 'Length' (int lengths) where the reference consumed LoD.
+"""
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _mask(lengths, T, dtype):
+    jnp = _jnp()
+    ar = jnp.arange(T)[None, :]
+    return (ar < lengths.reshape(-1, 1)).astype(dtype)
+
+
+@register("sequence_mask", grad=None, nondiff_inputs=("X",))
+def sequence_mask(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0].reshape(-1)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        maxlen = int(ctx.attr("maxlen_hint", 0)) or None
+        if maxlen is None:
+            raise ValueError("sequence_mask on TPU requires a static maxlen attr")
+    import numpy as np
+    out = (jnp.arange(maxlen)[None, :] < x[:, None])
+    return {"Y": [out.astype(np.dtype(ctx.attr("out_dtype", "int64")))]}
+
+
+@register("sequence_pool", nondiff_inputs=("Length",))
+def sequence_pool(ctx, ins):
+    """X: [B, T, D] padded; Length: [B]. pooltype: SUM/AVERAGE/MAX/LAST/FIRST/SQRT."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    lengths = ins["Length"][0]
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    B, T = x.shape[0], x.shape[1]
+    m = _mask(lengths, T, x.dtype).reshape(B, T, *([1] * (x.ndim - 2)))
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            lengths.reshape(-1, *([1] * (x.ndim - 2))).astype(x.dtype), 1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(
+            lengths.reshape(-1, *([1] * (x.ndim - 2))).astype(x.dtype), 1))
+    elif ptype == "MAX":
+        neg = jnp.asarray(-1e9, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lengths - 1, 0).astype("int32")
+        out = jnp.take_along_axis(
+            x, idx.reshape(-1, 1, *([1] * (x.ndim - 2))).astype("int32"),
+            axis=1).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register("sequence_softmax", nondiff_inputs=("Length",))
+def sequence_softmax(ctx, ins):
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]  # [B, T]
+    lengths = ins["Length"][0]
+    m = _mask(lengths, x.shape[1], x.dtype)
+    neg = jnp.asarray(-1e9, x.dtype)
+    out = jax.nn.softmax(jnp.where(m > 0, x, neg), axis=1) * m
+    return {"Out": [out]}
+
+
+@register("sequence_expand", nondiff_inputs=("Length",))
+def sequence_expand(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    return {"Out": [x]}
+
+
+@register("sequence_reverse", nondiff_inputs=("Length",))
+def sequence_reverse(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]  # [B, T, ...]
+    lengths = ins["Length"][0]
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    rev = lengths[:, None] - 1 - idx
+    rev = jnp.where(rev >= 0, rev, idx).astype("int32")
+    out = jnp.take_along_axis(x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)), axis=1)
+    return {"Y": [out]}
+
+
+@register("sequence_concat")
+def sequence_concat(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.concatenate([x for x in ins["X"] if x is not None], axis=-1)]}
+
+
+@register("im2sequence")
+def im2sequence(ctx, ins):
+    import jax
+    x = ins["X"][0]
+    kh, kw = ctx.attr("kernels", [1, 1])
+    sh, sw = ctx.attr("strides", [1, 1])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, c, oh, ow = patches.shape
+    return {"Out": [patches.transpose(0, 2, 3, 1).reshape(n, oh * ow, c)]}

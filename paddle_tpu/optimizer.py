@@ -1,0 +1,401 @@
+"""Optimizers (reference: python/paddle/fluid/optimizer.py, 19 classes, ~3.7k LoC).
+
+``Optimizer.minimize(loss)`` = append_backward + regularization + clipping + one
+update op per parameter, all inside the same Program -- so the whole training step
+compiles to a single XLA program (reference splits this across executors/op handles).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import unique_name
+from .clip import append_gradient_clip_ops
+from .core.backward import append_backward
+from .framework import (Parameter, Program, Variable, default_main_program,
+                        default_startup_program)
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators = {}
+        self._lr_var = None
+
+    # -- learning rate -----------------------------------------------------------------
+    def _create_lr_var(self):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        helper = LayerHelper("learning_rate")
+        self._lr_var = helper.create_global_variable(
+            [1], "float32", persistable=True,
+            name=unique_name.generate("learning_rate"),
+            initializer=Constant(float(self._learning_rate)))
+
+    def _lr(self, param=None):
+        lr = self._lr_var
+        mult = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0) \
+            if param is not None else 1.0
+        if mult == 1.0:
+            return lr
+        block = default_main_program().global_block()
+        out = block.create_var(unique_name.generate("lr_scaled"), (1,), "float32")
+        block.append_op("scale", inputs={"X": [lr]}, outputs={"Out": [out]},
+                        attrs={"scale": float(mult)})
+        return block.var(out.name)
+
+    # -- accumulators ------------------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None) -> Variable:
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        helper = LayerHelper(name)
+        v = helper.create_global_variable(
+            list(shape if shape is not None else param.shape),
+            dtype or "float32", persistable=True,
+            name=unique_name.generate(f"{param.name}_{name}"),
+            initializer=Constant(float(fill_value)))
+        self._accumulators[key] = v
+        return v
+
+    # -- to be implemented by subclasses ----------------------------------------------
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads) -> List:
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        self._create_lr_var()
+        block = default_main_program().global_block()
+        ops = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            ops.append(self._append_optimize_op(block, (p, g)))
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None
+                 ) -> Tuple[List, List[Tuple[Parameter, Variable]]]:
+        # All ops (backward, clip, regularization, update) must land in the
+        # *loss's* program, which may not be the current default (the reference
+        # passes programs explicitly; we scope the defaults for the duration).
+        from .framework import program_guard, default_startup_program
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(loss, startup_program, parameter_list,
+                                         no_grad_set)
+            ops = self.apply_gradients(params_grads)
+        return ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    """Reference optimizer.py:690."""
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "sgd", inputs={"Param": [p], "Grad": [g],
+                           "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p]})
+
+
+class MomentumOptimizer(Optimizer):
+    """Reference optimizer.py:758."""
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        vel = self._add_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [vel],
+                    "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [vel]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """Reference optimizer.py:1686 (LARS)."""
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        vel = self._add_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [vel],
+                    "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [vel]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+class AdamOptimizer(Optimizer):
+    """Reference optimizer.py:1108."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow_acc", p, self._beta2, shape=[1])
+        return block.append_op(
+            "adam",
+            inputs={"Param": [p], "Grad": [g], "LearningRate": [self._lr(p)],
+                    "Moment1": [m1], "Moment2": [m2], "Beta1Pow": [b1p],
+                    "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+
+class AdamWOptimizer(AdamOptimizer):
+    """Decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow_acc", p, self._beta2, shape=[1])
+        return block.append_op(
+            "adamw",
+            inputs={"Param": [p], "Grad": [g], "LearningRate": [self._lr(p)],
+                    "Moment1": [m1], "Moment2": [m2], "Beta1Pow": [b1p],
+                    "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "coeff": self._coeff})
+
+
+class AdagradOptimizer(Optimizer):
+    """Reference optimizer.py:1010."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        mom = self._add_accumulator("moment", p, self._initial)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [mom],
+                    "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [mom]},
+            attrs={"epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    """Reference optimizer.py:1300."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        mom = self._add_accumulator("moment", p)
+        inf = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, self._beta1, shape=[1])
+        op = block.append_op(
+            "adamax",
+            inputs={"Param": [p], "Grad": [g], "Moment": [mom], "InfNorm": [inf],
+                    "Beta1Pow": [b1p], "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [mom], "InfNormOut": [inf]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+        block.append_op("scale", inputs={"X": [b1p]}, outputs={"Out": [b1p]},
+                        attrs={"scale": self._beta1})
+        return op
+
+
+class AdadeltaOptimizer(Optimizer):
+    """Reference optimizer.py:1480."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        asg = self._add_accumulator("avg_squared_grad", p)
+        asu = self._add_accumulator("avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [asg],
+                    "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [p], "AvgSquaredGradOut": [asg],
+                     "AvgSquaredUpdateOut": [asu]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    """Reference optimizer.py:1554."""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        ms = self._add_accumulator("mean_square", p)
+        mom = self._add_accumulator("momentum", p)
+        inputs = {"Param": [p], "Grad": [g], "MeanSquare": [ms], "Moment": [mom],
+                  "LearningRate": [self._lr(p)]}
+        outputs = {"ParamOut": [p], "MeanSquareOut": [ms], "MomentOut": [mom]}
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p)
+            inputs["MeanGrad"] = [mg]
+            outputs["MeanGradOut"] = [mg]
+        return block.append_op(
+            "rmsprop", inputs=inputs, outputs=outputs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    """Reference optimizer.py:1803."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        sq = self._add_accumulator("squared", p)
+        lin = self._add_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": [p], "Grad": [g], "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin], "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class LambOptimizer(Optimizer):
+    """Reference optimizer.py:2291 (large-batch BERT training)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, exclude_from_weight_decay_fn=None,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._weight_decay = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow_acc", p, self._beta2, shape=[1])
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        return block.append_op(
+            "lamb",
+            inputs={"Param": [p], "Grad": [g], "LearningRate": [self._lr(p)],
+                    "Moment1": [m1], "Moment2": [m2], "Beta1Pow": [b1p],
+                    "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    """Reference optimizer.py:1399."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        mom = self._add_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [mom],
+                    "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [mom]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class DpsgdOptimizer(Optimizer):
+    """Differentially-private SGD (reference optimizer.py:952)."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "dpsgd", inputs={"Param": [p], "Grad": [g],
+                             "LearningRate": [self._lr(p)]},
+            outputs={"ParamOut": [p]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma})
+
+
+# Short aliases matching fluid.optimizer public names.
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Lamb = LambOptimizer
+Dpsgd = DpsgdOptimizer

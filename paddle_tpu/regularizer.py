@@ -1,0 +1,60 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+from .framework import default_main_program
+
+
+class WeightDecayRegularizer:
+    def append_regularization_op(self, param, grad):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad):
+        block = default_main_program().global_block()
+        decay = block.create_var(grad.name + "@L2DECAY", grad.shape, grad.dtype)
+        block.append_op("scale", inputs={"X": [param]}, outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff, "bias": 0.0,
+                               "bias_after_scale": True})
+        out = block.create_var(grad.name + "@REG", grad.shape, grad.dtype)
+        block.append_op("sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]})
+        return block.var(out.name)
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad):
+        block = default_main_program().global_block()
+        sign = block.create_var(grad.name + "@SIGN", grad.shape, grad.dtype)
+        block.append_op("sign", inputs={"X": [param]}, outputs={"Out": [sign]})
+        decay = block.create_var(grad.name + "@L1DECAY", grad.shape, grad.dtype)
+        block.append_op("scale", inputs={"X": [sign]}, outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff, "bias": 0.0,
+                               "bias_after_scale": True})
+        out = block.create_var(grad.name + "@REG", grad.shape, grad.dtype)
+        block.append_op("sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]})
+        return block.var(out.name)
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    """Reference regularizer.py:append_regularization_ops: per-param attr wins over
+    the optimizer-level setting."""
+    out = []
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or regularization
+        if reg is None or g is None:
+            out.append((p, g))
+            continue
+        out.append((p, reg.append_regularization_op(p, g)))
+    return out

@@ -1,0 +1,23 @@
+"""Test config: force CPU backend with 8 virtual devices for SPMD tests.
+
+Mirrors the reference's strategy of testing multi-device behavior on one host
+(SURVEY.md §4.5); the driver separately validates on real TPU.
+
+NOTE: this image's sitecustomize imports jax and registers the TPU (axon) PJRT
+plugin at interpreter start, so env vars alone don't switch backends -- we must
+update jax.config after import.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

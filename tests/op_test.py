@@ -1,0 +1,167 @@
+"""OpTest harness: numpy-oracle op checks + numeric-gradient checks.
+
+Reference analog: python/paddle/fluid/tests/unittests/op_test.py (check_output:732,
+check_grad:907, get_numeric_gradient:26). An op test declares op_type / inputs /
+outputs / attrs; check_output runs the single op through the real executor pipeline
+and compares to the declared numpy outputs; check_grad builds a tiny program
+(op + mean of outputs), runs append_backward, and compares analytic grads against
+central finite differences.
+"""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+class OpTest(unittest.TestCase):
+    op_type: str = ""
+
+    def setUp(self):
+        self.inputs = {}
+        self.outputs = {}
+        self.attrs = {}
+
+    # ----------------------------------------------------------------------------------
+    def _build(self, for_grad=False, grad_inputs=None):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_io = {}
+            feed = {}
+            for slot, val in self.inputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for nm, arr in entries:
+                    arr = np.asarray(arr)
+                    v = block.create_var(nm, arr.shape, str(arr.dtype),
+                                         is_data=True)
+                    v.stop_gradient = False
+                    names.append(nm)
+                    feed[nm] = arr
+                in_io[slot] = names
+            out_io = {}
+            for slot, val in self.outputs.items():
+                if isinstance(val, list):
+                    out_io[slot] = [nm for nm, _ in val]
+                else:
+                    out_io[slot] = [slot + "@OUT"]
+            block.append_op(self.op_type, inputs=in_io, outputs=out_io,
+                            attrs=self.attrs)
+        return main, startup, feed, out_io
+
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None):
+        main, startup, feed, out_io = self._build()
+        fetch = []
+        expected = []
+        for slot, val in self.outputs.items():
+            if no_check_set and slot in no_check_set:
+                continue
+            entries = val if isinstance(val, list) else [(out_io[slot][0], val)]
+            for (nm, arr), fetch_name in zip(entries, out_io[slot]):
+                fetch.append(fetch_name)
+                expected.append(np.asarray(arr))
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            results = exe.run(main, feed=feed, fetch_list=fetch)
+        for name, got, want in zip(fetch, results, expected):
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64) if got.dtype.kind == "f" else got,
+                np.asarray(want, dtype=np.float64) if want.dtype.kind == "f"
+                else want,
+                atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type}: output {name} mismatch")
+
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=0.005,
+                   numeric_grad_delta=1e-3, no_grad_set=None):
+        """Compare analytic grads (append_backward over the op) with central
+        finite differences of a scalar objective mean(output)."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_io, feed = {}, {}
+            for slot, val in self.inputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for nm, arr in entries:
+                    arr = np.asarray(arr)
+                    v = block.create_var(nm, arr.shape, str(arr.dtype),
+                                         is_data=True)
+                    v.stop_gradient = False
+                    names.append(nm)
+                    feed[nm] = arr
+                in_io[slot] = names
+            out_io = {}
+            for slot, val in self.outputs.items():
+                if isinstance(val, list):
+                    out_io[slot] = [nm for nm, _ in val]
+                else:
+                    out_io[slot] = [slot + "@OUT"]
+            block.append_op(self.op_type, inputs=in_io, outputs=out_io,
+                            attrs=self.attrs)
+            out_var_name = (output_name + "@OUT"
+                            if output_name in self.outputs and
+                            not isinstance(self.outputs[output_name], list)
+                            else output_name)
+            loss = block.var(out_var_name)
+            mean_out = block.create_var("mean@OUT", (1,), "float32")
+            block.append_op("mean", inputs={"X": [loss]},
+                            outputs={"Out": [mean_out]})
+            fluid.append_backward(block.var(mean_out.name),
+                                  no_grad_set=no_grad_set)
+
+        grad_names = [fluid.grad_var_name(n) for n in inputs_to_check]
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            analytic = exe.run(main, feed=feed, fetch_list=grad_names)
+
+        # numeric: central differences through a fresh forward-only program
+        fwd = fluid.Program()
+        with fluid.program_guard(fwd, fluid.Program()):
+            block = fwd.global_block()
+            for slot, val in self.inputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                for nm, arr in entries:
+                    arr = np.asarray(arr)
+                    block.create_var(nm, arr.shape, str(arr.dtype), is_data=True)
+            block.append_op(self.op_type, inputs=in_io, outputs=out_io,
+                            attrs=self.attrs)
+            mean_out2 = block.create_var("mean@OUT", (1,), "float32")
+            block.append_op("mean", inputs={"X": [out_var_name]},
+                            outputs={"Out": [mean_out2]})
+
+        exe2 = fluid.Executor()
+
+        def f(feed_override):
+            with fluid.scope_guard(fluid.Scope()):
+                r = exe2.run(fwd, feed=feed_override, fetch_list=["mean@OUT"])
+            return float(np.asarray(r[0]).reshape(()))
+
+        for name, got in zip(inputs_to_check, analytic):
+            base = np.asarray(feed[name], dtype=np.float64)
+            num = np.zeros_like(base).reshape(-1)
+            flat = base.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + numeric_grad_delta
+                fp = f({**feed, name: base.reshape(feed[name].shape)
+                        .astype(feed[name].dtype)})
+                flat[i] = orig - numeric_grad_delta
+                fm = f({**feed, name: base.reshape(feed[name].shape)
+                        .astype(feed[name].dtype)})
+                flat[i] = orig
+                num[i] = (fp - fm) / (2 * numeric_grad_delta)
+            num = num.reshape(base.shape)
+            got = np.asarray(got, dtype=np.float64)
+            abs_max = max(np.abs(num).max(), np.abs(got).max(), 1e-3)
+            diff = np.abs(num - got).max() / abs_max
+            self.assertLessEqual(
+                diff, max_relative_error,
+                msg=f"{self.op_type}: grad wrt {name}: relative diff {diff} "
+                    f"(analytic {got.reshape(-1)[:5]} vs numeric "
+                    f"{num.reshape(-1)[:5]})")

@@ -1,0 +1,113 @@
+"""append_backward tests (analog of reference test_backward.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(x, 8, act="relu")
+        pred = fluid.layers.fc(h, 3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(pred, label))
+    return main, startup, x, loss
+
+
+def test_append_backward_creates_grads():
+    main, startup, x, loss = _build_net()
+    with fluid.program_guard(main, startup):
+        pg = fluid.append_backward(loss)
+    assert len(pg) == 4  # 2x (W, b)
+    names = {p.name for p, g in pg}
+    for p, g in pg:
+        assert g.name.endswith("@GRAD")
+        assert tuple(g.shape) == tuple(p.shape)
+    types = [o.type for o in main.global_block().ops]
+    assert "fill_constant" in types  # loss seed
+    assert any(t.endswith("_grad") for t in types)
+
+
+def test_grad_values_match_finite_difference():
+    np.random.seed(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        w = fluid.layers.create_parameter([3, 2], "float32", name="w")
+        y = fluid.layers.matmul(x, w)
+        loss = fluid.layers.mean(fluid.layers.square(y))
+        pg = fluid.append_backward(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.random.randn(4, 3).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wv = np.asarray(scope.find_var("w"))
+        (gw,) = [g for p, g in pg if p.name == "w"]
+        analytic, lossv = exe.run(main, feed={"x": xv},
+                                  fetch_list=[gw, loss])
+    # numeric
+    def f(wmat):
+        y = xv @ wmat
+        return np.mean(y ** 2)
+    num = np.zeros_like(wv)
+    eps = 1e-3
+    for i in range(wv.shape[0]):
+        for j in range(wv.shape[1]):
+            wp, wm = wv.copy(), wv.copy()
+            wp[i, j] += eps
+            wm[i, j] -= eps
+            num[i, j] = (f(wp) - f(wm)) / (2 * eps)
+    np.testing.assert_allclose(analytic, num, rtol=1e-2, atol=1e-4)
+
+
+def test_grad_accumulation_multiple_uses():
+    """A var consumed by two ops accumulates both grad contributions (the
+    reference's _addup_repetitive_outputs_)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        w = fluid.layers.create_parameter([3], "float32", name="w")
+        a = fluid.layers.elementwise_mul(x, w)
+        b = fluid.layers.elementwise_add(x, w)  # w used twice
+        loss = fluid.layers.mean(a + b)
+        pg = fluid.append_backward(loss)
+    exe = fluid.Executor()
+    xv = np.ones((2, 3), "float32") * 2.0
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (gw,) = [g for p, g in pg if p.name == "w"]
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[gw])
+    # d/dw mean(x*w + x + w) over 2x3 elements = (x + 1)/6 summed over batch
+    expect = (xv + 1.0).sum(0) / 6.0
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_stop_gradient_pruning():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        w1 = fluid.layers.create_parameter([3], "float32", name="w1")
+        w2 = fluid.layers.create_parameter([3], "float32", name="w2")
+        w2.trainable = False
+        w2.stop_gradient = True
+        loss = fluid.layers.mean(x * w1 + w2)
+        pg = fluid.append_backward(loss)
+    names = {p.name for p, g in pg}
+    assert names == {"w1"}
+
+
+def test_gradients_api():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        x.stop_gradient = False
+        y = fluid.layers.square(x)
+        (gx,) = fluid.gradients(fluid.layers.reduce_sum(y), x)
+    exe = fluid.Executor()
+    xv = np.array([[1.0, 2.0, 3.0]], "float32")
+    with fluid.scope_guard(fluid.Scope()):
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(got, 2 * xv, rtol=1e-6)

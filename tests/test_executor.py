@@ -1,0 +1,78 @@
+"""Executor tests (analog of reference test_executor_and_mul.py etc.)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_run_simple_program():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [3], "float32")
+        y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        out, = exe.run(main, feed={"x": np.ones((2, 3), "float32")},
+                       fetch_list=[y])
+    np.testing.assert_allclose(out, np.full((2, 3), 3.0), rtol=1e-6)
+
+
+def test_startup_then_main_with_params():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.ones((5, 4), "float32")},
+                       fetch_list=[y])
+    assert out.shape == (5, 2)
+
+
+def test_uninitialized_param_error():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        try:
+            exe.run(main, feed={"x": np.ones((5, 4), "float32")},
+                    fetch_list=[y])
+            assert False, "expected error"
+        except RuntimeError as e:
+            assert "startup" in str(e)
+
+
+def test_compile_cache_reuse_and_invalidation():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [3], "float32")
+        y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(main, feed={"x": np.ones((2, 3), "float32")}, fetch_list=[y])
+        assert len(exe._cache) == 1
+        exe.run(main, feed={"x": np.ones((2, 3), "float32")}, fetch_list=[y])
+        assert len(exe._cache) == 1  # hit
+        exe.run(main, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[y])
+        assert len(exe._cache) == 2  # new batch size -> new entry
+
+
+def test_state_mutation_batch_norm_stats():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 8, 8], "float32")
+        y = fluid.layers.batch_norm(x, momentum=0.5)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        mean_name = [n for n in scope.var_names() if "global" in n][0]
+        before = np.asarray(scope.find_var(mean_name)).copy()
+        exe.run(main, feed={"x": np.random.RandomState(0)
+                            .randn(2, 4, 8, 8).astype("float32") + 5.0},
+                fetch_list=[y])
+        after = np.asarray(scope.find_var(mean_name))
+    assert not np.allclose(before, after), "running stats must update"

@@ -1,0 +1,77 @@
+"""Core IR tests (analog of reference framework unit tests: test_program.py,
+test_operator_desc.py, test_variable.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_program_build_and_shapes():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [784], "float32")
+        assert x.shape == (-1, 784)
+        y = fluid.layers.fc(x, 10)
+        assert y.shape == (-1, 10)
+        assert len(main.global_block().ops) >= 2
+        params = main.all_parameters()
+        assert len(params) == 2  # W, b
+        assert params[0].shape == (784, 10)
+    # startup got the init ops
+    assert len(startup.global_block().ops) == 2
+
+
+def test_program_serialization_roundtrip():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 3, act="relu")
+    s = main.to_json()
+    p2 = fluid.Program.from_json(s)
+    assert len(p2.global_block().ops) == len(main.global_block().ops)
+    assert [o.type for o in p2.global_block().ops] == \
+        [o.type for o in main.global_block().ops]
+    params2 = p2.all_parameters()
+    assert len(params2) == 2
+
+
+def test_program_clone_for_test():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [4], "float32")
+        d = fluid.layers.dropout(x, 0.5)
+    t = main.clone(for_test=True)
+    drop_ops = [o for o in t.global_block().ops if o.type == "dropout"]
+    assert drop_ops and drop_ops[0].attr("is_test") is True
+    # original untouched
+    assert not main.global_block().ops[-1].attr("is_test", False)
+
+
+def test_variable_sugar_builds_ops():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.data("y", [4], "float32")
+        z = x + y * 2.0
+    types = [o.type for o in main.global_block().ops]
+    assert "elementwise_add" in types and "elementwise_mul" in types
+
+
+def test_shape_inference_dynamic_batch():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("img", [1, 28, 28], "float32")
+        c = fluid.layers.conv2d(x, 8, 3, padding=1)
+        assert c.shape == (-1, 8, 28, 28)
+        p = fluid.layers.pool2d(c, 2, "max", 2)
+        assert p.shape == (-1, 8, 14, 14)
+
+
+def test_unregistered_op_raises():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [4], "float32")
+        with pytest.raises((KeyError, RuntimeError)):
+            main.global_block().append_op("not_a_real_op", inputs={"X": [x]},
+                                          outputs={"Out": ["o"]})

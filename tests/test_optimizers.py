@@ -1,0 +1,146 @@
+"""Optimizer tests (analog of reference test_optimizer.py + book tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _quadratic_problem(opt_factory):
+    """Minimize ||w - target||^2 with the given optimizer; return final distance."""
+    main, startup = fluid.Program(), fluid.Program()
+    target = np.arange(6, dtype="float32").reshape(2, 3) / 6.0
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter([2, 3], "float32", name="w")
+        t = fluid.layers.assign(target)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(w - t))
+        opt = opt_factory()
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(150):
+            lossv, = exe.run(main, fetch_list=[loss])
+        return float(np.asarray(lossv).reshape(()))
+
+
+@pytest.mark.parametrize("factory", [
+    lambda: fluid.optimizer.SGD(0.5),
+    lambda: fluid.optimizer.Momentum(0.1, 0.9),
+    lambda: fluid.optimizer.Momentum(0.1, 0.9, use_nesterov=True),
+    lambda: fluid.optimizer.Adam(0.1),
+    lambda: fluid.optimizer.AdamW(0.1),
+    lambda: fluid.optimizer.Adagrad(0.5),
+    lambda: fluid.optimizer.Adamax(0.1),
+    lambda: fluid.optimizer.Adadelta(1.0, rho=0.9, epsilon=0.1),
+    lambda: fluid.optimizer.RMSProp(0.05),
+    lambda: fluid.optimizer.Lamb(0.1, lamb_weight_decay=0.0),
+    lambda: fluid.optimizer.DecayedAdagrad(0.05, decay=0.5),
+    lambda: fluid.optimizer.Ftrl(0.5),
+    lambda: fluid.optimizer.LarsMomentum(1.0, 0.9, lars_coeff=0.01),
+], ids=["sgd", "momentum", "nesterov", "adam", "adamw", "adagrad", "adamax",
+        "adadelta", "rmsprop", "lamb", "decayed_adagrad", "ftrl", "lars"])
+def test_optimizer_converges(factory):
+    final = _quadratic_problem(factory)
+    assert final < 2e-2, f"did not converge: {final}"
+
+
+def test_regularizer_l2_changes_update():
+    def run(reg):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [3], "float32")
+            w = fluid.layers.create_parameter([3], "float32", name="w")
+            loss = fluid.layers.mean(x * w)
+            opt = fluid.optimizer.SGD(0.1, regularization=reg)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()) as _:
+            sc = fluid.global_scope()
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((2, 3), "float32")},
+                    fetch_list=[loss])
+            return np.asarray(sc.find_var("w")).copy()
+
+    w_plain = run(None)
+    w_reg = run(fluid.regularizer.L2Decay(0.5))
+    assert not np.allclose(w_plain, w_reg)
+
+
+def test_grad_clip_by_global_norm():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        w = fluid.layers.create_parameter([3], "float32", name="w")
+        loss = fluid.layers.mean(x * w) * 1000.0  # huge grads
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+        opt = fluid.optimizer.SGD(1.0)
+        _, pg = opt.minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        sc = fluid.global_scope()
+        exe.run(startup)
+        before = np.asarray(sc.find_var("w")).copy()
+        exe.run(main, feed={"x": np.ones((2, 3), "float32")}, fetch_list=[loss])
+        after = np.asarray(sc.find_var("w"))
+    # with clip_norm=1 and lr=1, the step length <= 1
+    assert np.linalg.norm(after - before) <= 1.0 + 1e-4
+
+
+def test_lr_scheduler_piecewise():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        w = fluid.layers.create_parameter([3], "float32", name="w")
+        loss = fluid.layers.mean(x * w)
+        lr = fluid.layers.piecewise_decay([2, 4], [1.0, 0.1, 0.01])
+        opt = fluid.optimizer.SGD(lr)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lrs = []
+        for _ in range(5):
+            lrv, = exe.run(main, feed={"x": np.ones((1, 3), "float32")},
+                           fetch_list=[lr])
+            lrs.append(float(np.asarray(lrv).reshape(())))
+    assert lrs[0] == pytest.approx(1.0)
+    assert lrs[2] == pytest.approx(0.1)
+    assert lrs[4] == pytest.approx(0.01)
+
+
+def test_mnist_mlp_converges():
+    """Minimum end-to-end slice (SURVEY.md §7 stage 2): a 2-layer MLP on a toy
+    10-class problem must drive loss down via the full DSL->IR->backward->XLA path."""
+    rng = np.random.RandomState(42)
+    n, d, k = 256, 32, 10
+    wtrue = rng.randn(d, k).astype("float32")
+    xs = rng.randn(n, d).astype("float32")
+    ys = np.argmax(xs @ wtrue + 0.1 * rng.randn(n, k), axis=1)[:, None] \
+        .astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [d], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(img, 64, act="relu")
+        logits = fluid.layers.fc(h, k)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(logits, label)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        first = None
+        for epoch in range(30):
+            lossv, accv = exe.run(main, feed={"img": xs, "label": ys},
+                                  fetch_list=[loss, acc])
+            if first is None:
+                first = float(lossv[0])
+        final, final_acc = float(lossv[0]), float(accv[0])
+    assert first > 1.5  # ~ln(10) at init
+    assert final < 0.3 * first, f"loss {first} -> {final}: not converging"
+    assert final_acc > 0.9

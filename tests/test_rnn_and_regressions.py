@@ -1,0 +1,74 @@
+"""Scan/RNN lowering tests + regressions for review findings."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_clone_keeps_parameters():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [4], "float32")
+        fluid.layers.fc(x, 2)
+    clone = main.clone(for_test=True)
+    assert [p.name for p in clone.all_parameters()] == \
+        [p.name for p in main.all_parameters()]
+
+
+def test_minimize_outside_program_guard():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+    # valid in the reference API: minimize after leaving the guard
+    fluid.optimizer.SGD(0.1).minimize(loss, startup_program=startup)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        l0, = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                      fetch_list=[loss])
+    assert np.isfinite(l0).all()
+    assert any(o.type == "sgd" for o in main.global_block().ops)
+
+
+def test_gru_scan_trains():
+    """RNN via Scan -> lax.scan: params created inside the body are visible,
+    shapes are right, and gradients flow through the recurrence."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        seq = fluid.data("seq", [5, 3], "float32")       # [B, T, D]
+        target = fluid.data("target", [1], "float32")
+        h = fluid.layers.simple_gru(seq, 8)
+        assert h.shape == (-1, 5, 8)
+        last = h[:, 4]                                    # [B, 8]
+        pred = fluid.layers.fc(last, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, target))
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 5, 3).astype("float32")
+    ys = xs.sum(axis=(1, 2), keepdims=False)[:, None].astype("float32") * 0.1
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(80):
+            lv, = exe.run(main, feed={"seq": xs, "target": ys},
+                          fetch_list=[loss])
+            losses.append(float(lv[0]))
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_lstm_scan_forward():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        seq = fluid.data("seq", [4, 6], "float32")
+        h = fluid.layers.simple_lstm(seq, 5)
+        assert h.shape == (-1, 4, 5)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"seq": np.ones((3, 4, 6), "float32")},
+                       fetch_list=[h])
+    assert out.shape == (3, 4, 5)
+    assert np.isfinite(out).all()
