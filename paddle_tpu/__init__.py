@@ -22,6 +22,8 @@ from . import regularizer  # noqa: F401
 from . import clip  # noqa: F401
 from .layer_helper import LayerHelper, ParamAttr, WeightNormParamAttr  # noqa
 from .layers.io import data  # noqa: F401
+from .compiler import (CompiledProgram, BuildStrategy, ExecutionStrategy,  # noqa
+                       DistributedStrategy)
 
 __version__ = "0.1.0"
 

@@ -163,7 +163,6 @@ class Executor:
         self.place = place
         self._cache: "collections.OrderedDict[Tuple, _CompiledStep]" = \
             collections.OrderedDict()
-        self._step_counter = 0
 
     # -- public API --------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
@@ -172,11 +171,31 @@ class Executor:
         import jax
 
         program = program or default_main_program()
+        compiled_wrapper = None
+        if not isinstance(program, Program):  # CompiledProgram front door
+            compiled_wrapper = program
+            program = compiled_wrapper.program
         feed = dict(feed or {})
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in (fetch_list or [])]
         scope = scope or global_scope()
 
+        if compiled_wrapper is not None and compiled_wrapper.dist_strategy:
+            ds = compiled_wrapper.dist_strategy
+            compiled_wrapper.mesh  # force mesh build (fills default mesh_shape)
+            for k, v in feed.items():
+                shape = np.shape(v)
+                spec = ds.data_spec(k, len(shape))
+                for dim, axes in enumerate(spec):
+                    if axes is None or dim >= len(shape):
+                        continue
+                    for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                        n = ds.mesh_shape.get(ax, 1)
+                        if n and shape[dim] % n != 0:
+                            raise ValueError(
+                                f"feed {k!r} dim {dim} (={shape[dim]}) is not "
+                                f"divisible by mesh axis {ax!r} ({n} devices); "
+                                f"pad or drop the remainder batch")
         state_in, state_out = self._state_names(program, feed, fetch_names)
         missing = [n for n in state_in if not scope.has_var(n) or
                    scope.find_var(n) is None]
@@ -188,11 +207,14 @@ class Executor:
         feed_sig = tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)
                                  if not hasattr(v, "dtype") else str(v.dtype))
                                 for k, v in feed.items()))
-        key = (id(program), program._version, feed_sig, tuple(fetch_names))
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               compiled_wrapper.strategy_signature()
+               if compiled_wrapper is not None else ())
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._compile(program, list(feed), fetch_names,
-                                     state_in, state_out)
+                                     state_in, state_out,
+                                     wrapper=compiled_wrapper)
             self._cache[key] = compiled
             while len(self._cache) > self._CACHE_CAP:
                 self._cache.popitem(last=False)
@@ -203,9 +225,13 @@ class Executor:
         mut_vals = {n: scope.find_var(n) for n in mut_names}
         ro_vals = {n: scope.find_var(n) for n in ro_names}
         feed_vals = {k: _as_device_array(v) for k, v in feed.items()}
+        # The PRNG key for run k of a program is fold_in(PRNGKey(seed), k); the
+        # counter lives on the Program so results are deterministic per program
+        # regardless of what else ran (matters for seeded init).
         seed = program.random_seed if program.random_seed is not None else 0
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._step_counter)
-        self._step_counter += 1
+        counter = getattr(program, "_rng_run_counter", 0)
+        program._rng_run_counter = counter + 1
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
 
         fetches, new_state = compiled.fn(mut_vals, ro_vals, feed_vals, rng)
         for n, v in new_state.items():
@@ -243,7 +269,8 @@ class Executor:
                 read.append(n)
         return read, written
 
-    def _compile(self, program: Program, feed_names, fetch_names, state_in, state_out):
+    def _compile(self, program: Program, feed_names, fetch_names, state_in,
+                 state_out, wrapper=None):
         import jax
 
         block = program.global_block()
@@ -278,7 +305,41 @@ class Executor:
             new_state = {n: env[n] for n in state_out if n in env}
             return fetches, new_state
 
-        jitted = jax.jit(step, donate_argnums=(0,))
+        if wrapper is not None and wrapper.dist_strategy is not None:
+            # SPMD path (the ParallelExecutor analog): jit over the strategy's mesh
+            # with sharding constraints on state and feeds; XLA/GSPMD inserts the
+            # ICI collectives the reference implemented as AllReduceOpHandles.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ds = wrapper.dist_strategy
+            mesh = wrapper.mesh
+            var_of = block.find_var_recursive
+
+            def state_sharding(names):
+                out = {}
+                for n in names:
+                    v = var_of(n)
+                    spec = ds.param_spec(n) if v is not None else P()
+                    out[n] = NamedSharding(mesh, spec)
+                return out
+
+            in_shardings = (
+                state_sharding(mut_names),
+                state_sharding(ro_names),
+                {n: NamedSharding(
+                    mesh, ds.data_spec(n, len(var_of(n).shape)
+                                       if var_of(n) is not None else 1))
+                 for n in feed_names},
+                NamedSharding(mesh, P()),
+            )
+            out_shardings = (
+                [NamedSharding(mesh, P())] * len(fetch_names),
+                state_sharding(state_out),
+            )
+            jitted = jax.jit(step, donate_argnums=(0,),
+                             in_shardings=in_shardings,
+                             out_shardings=out_shardings)
+        else:
+            jitted = jax.jit(step, donate_argnums=(0,))
         return _CompiledStep(jitted, (mut_names, ro_names), state_out, fetch_names)
 
 

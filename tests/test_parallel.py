@@ -1,0 +1,115 @@
+"""SPMD tests: loss parity single-device vs sharded mesh.
+
+Analog of the reference's TestParallelExecutorBase pattern
+(parallel_executor_test_base.py): run the same model single-device and
+multi-device and assert loss parity, on the 8-virtual-device CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _mlp_program(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [32], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(x, 64, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _train(program_for_run, main, startup, loss, steps=8):
+    rng = np.random.RandomState(0)
+    W = rng.randn(32, 10).astype("float32")
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(steps):
+            bs = 64
+            x = rng.randn(bs, 32).astype("float32")
+            y = np.argmax(x @ W, 1)[:, None].astype("int64")
+            lv, = exe.run(program_for_run, feed={"x": x, "label": y},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    return losses
+
+
+def test_dp8_loss_parity():
+    import jax
+    assert len(jax.devices()) == 8
+    main, startup, loss = _mlp_program()
+    single = _train(main, main, startup, loss)
+
+    main2, startup2, loss2 = _mlp_program()
+    cp = fluid.CompiledProgram(main2).with_data_parallel(loss_name=loss2.name)
+    par = _train(cp, main2, startup2, loss2)
+
+    np.testing.assert_allclose(single, par, rtol=2e-4, atol=1e-5)
+    assert par[-1] < par[0]
+
+
+def test_dp_params_stay_synchronized():
+    """Replicated params sharded over the mesh must be identical after updates."""
+    import jax
+    main, startup, loss = _mlp_program(seed=5)
+    cp = fluid.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+    rng = np.random.RandomState(1)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        sc = fluid.global_scope()
+        exe.run(startup)
+        for _ in range(3):
+            x = rng.randn(16, 32).astype("float32")
+            y = rng.randint(0, 10, (16, 1)).astype("int64")
+            exe.run(cp, feed={"x": x, "label": y}, fetch_list=[loss])
+        w = sc.find_var("fc_0.w_0")
+        # fully-replicated output sharding -> value is well-defined; check finite
+        wv = np.asarray(w)
+        assert np.isfinite(wv).all()
+
+
+def test_tensor_parallel_fc():
+    """Column-parallel weight sharding over an 'mp' axis: results must match the
+    replicated run (the transpiler-test analog: assert the *semantics*, the
+    sharding spec is the 'rewritten program')."""
+    import jax
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [16], "float32")
+            h = fluid.layers.fc(x, 32, act="relu",
+                                param_attr=fluid.ParamAttr(name="tp_w1"))
+            y = fluid.layers.fc(h, 8, param_attr=fluid.ParamAttr(name="tp_w2"))
+            loss = fluid.layers.mean(y)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    xv = np.random.RandomState(2).randn(8, 16).astype("float32")
+
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+
+    main2, startup2, loss2 = build()
+    strat = fluid.DistributedStrategy(
+        mesh_shape={"dp": 2, "mp": 4},
+        param_rules=[("tp_w1", (None, "mp")),   # column parallel
+                     ("tp_w2", ("mp", None))])  # row parallel
+    cp = fluid.CompiledProgram(main2).with_strategy(strat)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        got, = exe.run(cp, feed={"x": xv}, fetch_list=[loss2])
+
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
