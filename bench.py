@@ -1,0 +1,81 @@
+"""Benchmark: ResNet-50 training images/sec on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): reference target is >=0.8x per-chip throughput vs a V100
+running the reference's CUDA path. V100 fp32 ResNet-50 training is ~360 images/sec
+(the reference era's standard number; its own float16_benchmark.md only covers
+inference). vs_baseline = value / 360.
+
+Method notes:
+- bf16 activations/weights (MXU-native), batch-norm statistics in f32.
+- feeds are pre-staged on device; no per-step host<->device transfers (the axon
+  relay's d2h costs ~140ms and would swamp the measurement, see
+  .claude/skills/verify/SKILL.md).
+- The whole train step (fwd+bwd+momentum update) is one XLA program; timing is
+  wall clock over N steps after warmup, synchronized via block_until_ready on a
+  donated state buffer.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def bench_resnet50(batch=64, image=224, steps=32, warmup=2, dtype="bfloat16"):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, image, image], dtype)
+        label = fluid.data("label", [1], "int64")
+        loss, acc, _ = resnet.resnet50(img, label, num_classes=1000)
+        fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    img_np = rng.randn(batch, 3, image, image).astype(np.float32)
+    feed = {
+        "img": jax.device_put(jax.numpy.asarray(img_np, dtype=dtype)),
+        "label": jax.device_put(
+            rng.randint(0, 1000, (batch, 1)).astype(np.int32)),
+    }
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed=feed, fetch_list=[], return_numpy=False)
+        # sync before timing
+        jax.block_until_ready(scope.find_var("fc_0.w_0"))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            exe.run(main, feed=feed, fetch_list=[], return_numpy=False)
+        jax.block_until_ready(scope.find_var("fc_0.w_0"))
+        dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def main():
+    value = bench_resnet50()
+    baseline_v100_fp32 = 360.0
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(value / baseline_v100_fp32, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,9 +34,11 @@ class ExecutionStrategy:
 
 
 class BuildStrategy:
-    """Reference details/build_strategy.h:38. Knobs that map to something real on TPU
-    are honored (reduce_strategy -> parameter sharding, fuse_* -> XLA fusion always
-    on); the rest are accepted no-ops."""
+    """Reference details/build_strategy.h:38. All knobs are currently accepted
+    no-ops for port compatibility: the fusion/memory knobs are subsumed by XLA
+    (fusion and buffer reuse are always on), and reduce_strategy=Reduce (ZeRO-like
+    optimizer-state sharding over dp) is not implemented yet -- express parameter
+    sharding through DistributedStrategy.param_rules instead."""
 
     class ReduceStrategy:
         AllReduce = 0   # replicated params (default)

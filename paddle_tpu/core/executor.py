@@ -189,13 +189,14 @@ class Executor:
                 for dim, axes in enumerate(spec):
                     if axes is None or dim >= len(shape):
                         continue
+                    n = 1
                     for ax in (axes if isinstance(axes, tuple) else (axes,)):
-                        n = ds.mesh_shape.get(ax, 1)
-                        if n and shape[dim] % n != 0:
-                            raise ValueError(
-                                f"feed {k!r} dim {dim} (={shape[dim]}) is not "
-                                f"divisible by mesh axis {ax!r} ({n} devices); "
-                                f"pad or drop the remainder batch")
+                        n *= ds.mesh_shape.get(ax, 1)
+                    if n > 1 and shape[dim] % n != 0:
+                        raise ValueError(
+                            f"feed {k!r} dim {dim} (={shape[dim]}) is not "
+                            f"divisible by mesh axes {axes!r} ({n} shards); "
+                            f"pad or drop the remainder batch")
         state_in, state_out = self._state_names(program, feed, fetch_names)
         missing = [n for n in state_in if not scope.has_var(n) or
                    scope.find_var(n) is None]

@@ -1,0 +1,7 @@
+"""Model zoo covering the five BASELINE configs (BASELINE.md):
+MNIST MLP, ResNet-50, BERT-base pretrain, DeepFM CTR, Transformer NMT."""
+from . import mnist      # noqa: F401
+from . import resnet     # noqa: F401
+from . import bert       # noqa: F401
+from . import deepfm     # noqa: F401
+from . import transformer  # noqa: F401

@@ -1,0 +1,168 @@
+"""BERT-base pretraining graph (reference pattern: dist_transformer.py +
+multihead_matmul_fuse_pass.cc shows the attention structure the reference fuses for
+inference; here the whole encoder is one XLA program so the "fusion pass" is moot).
+
+Parameter names are chosen so tensor-parallel sharding rules match them:
+  *_qkv_w  [H, 3H]   -> (None, "mp")   column parallel
+  *_out_w  [H, H]    -> ("mp", None)   row parallel
+  *_ffn1_w [H, 4H]   -> (None, "mp")
+  *_ffn2_w [4H, H]   -> ("mp", None)
+Embeddings shard over vocab ("mp", None) or replicate.
+
+TP sharding rules for these names are exported as ``tp_param_rules()``.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..layer_helper import ParamAttr
+from ..initializer import Normal, Constant
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden=768, n_layers=12, n_heads=12,
+                 ffn_hidden=None, max_seq_len=512, type_vocab=2, dropout=0.1,
+                 dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.ffn_hidden = ffn_hidden or hidden * 4
+        self.max_seq_len = max_seq_len
+        self.type_vocab = type_vocab
+        self.dropout = dropout
+        self.dtype = dtype
+
+
+def base_config(**kw):
+    return BertConfig(n_layers=kw.pop("n_layers", 12), **kw)
+
+
+def _dense(x, size, name, num_flatten_dims=2, act=None):
+    return layers.fc(x, size, num_flatten_dims=num_flatten_dims, act=act,
+                     param_attr=ParamAttr(name=name + "_w",
+                                          initializer=Normal(0.0, 0.02)),
+                     bias_attr=ParamAttr(name=name + "_b",
+                                         initializer=Constant(0.0)))
+
+
+def attention(x, cfg: BertConfig, mask_bias, name):
+    """Multi-head self-attention. x: [B,S,H]; mask_bias: [B,1,1,S] additive."""
+    B_H = cfg.hidden
+    qkv = _dense(x, 3 * B_H, name + "_qkv")                    # [B,S,3H]
+    q, k, v = layers.split(qkv, 3, dim=2)
+    d_head = B_H // cfg.n_heads
+
+    def to_heads(t):  # [B,S,H] -> [B,heads,S,d]
+        t = layers.reshape(t, [0, -1, cfg.n_heads, d_head])    # 0 copies B; -1=S
+        return layers.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=1.0 / math.sqrt(d_head))      # [B,h,S,S]
+    if mask_bias is not None:
+        scores = layers.elementwise_add(scores, mask_bias)
+    probs = layers.softmax(scores)
+    if cfg.dropout:
+        probs = layers.dropout(probs, cfg.dropout,
+                               dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(probs, v)                              # [B,h,S,d]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, -1, B_H])
+    return _dense(ctx, B_H, name + "_out")
+
+
+def encoder_layer(x, cfg: BertConfig, mask_bias, name):
+    attn = attention(x, cfg, mask_bias, name + "_attn")
+    if cfg.dropout:
+        attn = layers.dropout(attn, cfg.dropout,
+                              dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(layers.elementwise_add(x, attn), begin_norm_axis=2)
+    ffn = _dense(x, cfg.ffn_hidden, name + "_ffn1", act="gelu")
+    ffn = _dense(ffn, cfg.hidden, name + "_ffn2")
+    if cfg.dropout:
+        ffn = layers.dropout(ffn, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(x, ffn), begin_norm_axis=2)
+
+
+def encoder(src_ids, pos_ids, sent_ids, input_mask, cfg: BertConfig):
+    """Embeddings + transformer stack. input_mask: [B,S] 1/0 float."""
+    emb = layers.embedding(src_ids, [cfg.vocab_size, cfg.hidden],
+                           param_attr=ParamAttr(name="word_emb",
+                                                initializer=Normal(0.0, 0.02)))
+    pos = layers.embedding(pos_ids, [cfg.max_seq_len, cfg.hidden],
+                           param_attr=ParamAttr(name="pos_emb",
+                                                initializer=Normal(0.0, 0.02)))
+    sent = layers.embedding(sent_ids, [cfg.type_vocab, cfg.hidden],
+                            param_attr=ParamAttr(name="sent_emb",
+                                                 initializer=Normal(0.0, 0.02)))
+    x = layers.elementwise_add(layers.elementwise_add(emb, pos), sent)
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    if cfg.dropout:
+        x = layers.dropout(x, cfg.dropout,
+                           dropout_implementation="upscale_in_train")
+    if cfg.dtype == "bfloat16":
+        x = layers.cast(x, "bfloat16")
+    # additive attention bias: (mask-1) * 1e4 -> -1e4 where padded
+    bias = layers.scale(input_mask, scale=1e4, bias=-1e4)      # [B,S]
+    bias = layers.unsqueeze(layers.unsqueeze(bias, [1]), [1])  # [B,1,1,S]
+    if cfg.dtype == "bfloat16":
+        bias = layers.cast(bias, "bfloat16")
+    for i in range(cfg.n_layers):
+        x = encoder_layer(x, cfg, bias, f"layer{i}")
+    return x
+
+
+def pretrain(src_ids, pos_ids, sent_ids, input_mask, mask_pos, mask_label,
+             nsp_label, cfg: BertConfig):
+    """BERT pretrain loss = masked-LM + next-sentence (reference-style).
+
+    mask_pos: [M,1] int -- flat indices into [B*S] of masked tokens;
+    mask_label: [M,1] int64; nsp_label: [B,1] int64.
+    Returns (total_loss, mlm_loss, nsp_acc).
+    """
+    enc = encoder(src_ids, pos_ids, sent_ids, input_mask, cfg)   # [B,S,H]
+    if cfg.dtype == "bfloat16":
+        enc = layers.cast(enc, "float32")
+    flat = layers.reshape(enc, [-1, cfg.hidden])                 # [B*S,H]
+    masked = layers.gather(flat, mask_pos)                       # [M,1,H]?? gather on [M,1]
+    masked = layers.reshape(masked, [-1, cfg.hidden])
+    mlm_h = layers.fc(masked, cfg.hidden, act="gelu",
+                      param_attr=ParamAttr(name="mlm_trans_w",
+                                           initializer=Normal(0.0, 0.02)))
+    mlm_h = layers.layer_norm(mlm_h, begin_norm_axis=1)
+    # output projection tied-shape (not tied-weight for simplicity round 1)
+    mlm_logits = layers.fc(mlm_h, cfg.vocab_size,
+                           param_attr=ParamAttr(name="mlm_out_w",
+                                                initializer=Normal(0.0, 0.02)))
+    mlm_loss = layers.mean(
+        layers.softmax_with_cross_entropy(mlm_logits, mask_label))
+
+    pooled = layers.fc(layers.slice(enc, [1], [0], [1]), cfg.hidden, act="tanh",
+                       num_flatten_dims=1,
+                       param_attr=ParamAttr(name="pooler_w",
+                                            initializer=Normal(0.0, 0.02)))
+    nsp_logits = layers.fc(pooled, 2,
+                           param_attr=ParamAttr(name="nsp_w",
+                                                initializer=Normal(0.0, 0.02)))
+    nsp_loss = layers.mean(
+        layers.softmax_with_cross_entropy(nsp_logits, nsp_label))
+    nsp_acc = layers.accuracy(nsp_logits, nsp_label)
+    total = layers.elementwise_add(mlm_loss, nsp_loss)
+    return total, mlm_loss, nsp_acc
+
+
+def tp_param_rules():
+    """PartitionSpec rules for tensor parallelism over axis 'mp'."""
+    return [
+        (r"_qkv_w$", (None, "mp")),
+        (r"_qkv_b$", ("mp",)),
+        (r"_out_w$", ("mp", None)),
+        (r"_ffn1_w$", (None, "mp")),
+        (r"_ffn1_b$", ("mp",)),
+        (r"_ffn2_w$", ("mp", None)),
+        (r"^word_emb$", ("mp", None)),
+        (r"^mlm_out_w$", (None, "mp")),
+    ]

@@ -1,0 +1,61 @@
+"""DeepFM / wide&deep CTR model (reference path: lookup_table sparse embedding +
+pserver DistributeTranspiler, tests/unittests/dist_ctr.py).
+
+TPU-native: the embedding table is a dense parameter; shard it over the 'ep'/'mp'
+mesh axis via ep_param_rules() instead of slicing across pservers. Gradients are
+XLA scatter-adds fused into the step (the SelectedRows path is unnecessary on TPU).
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..layer_helper import ParamAttr
+from ..initializer import Normal, Uniform
+
+
+def deepfm(sparse_ids, dense_feat, label, num_fields, vocab_size=100000,
+           embed_dim=16, hidden=(400, 400, 400)):
+    """sparse_ids: [B, num_fields] int64; dense_feat: [B, D] float; label [B,1].
+
+    Returns (loss, auc_var, predictions).
+    """
+    # first-order: per-feature scalar weights
+    w1 = layers.embedding(sparse_ids, [vocab_size, 1],
+                          param_attr=ParamAttr(name="fm_w1",
+                                               initializer=Uniform(-1e-3, 1e-3)))
+    first_order = layers.reduce_sum(layers.reshape(w1, [-1, num_fields]), 1,
+                                    keep_dim=True)
+    # second-order FM: 0.5*((sum v)^2 - sum v^2)
+    emb = layers.embedding(sparse_ids, [vocab_size, embed_dim],
+                           param_attr=ParamAttr(name="fm_v",
+                                                initializer=Uniform(-1e-3, 1e-3)))
+    # emb: [B, num_fields, embed_dim]
+    sum_v = layers.reduce_sum(emb, 1)                       # [B, E]
+    sum_sq = layers.square(sum_v)
+    sq_sum = layers.reduce_sum(layers.square(emb), 1)
+    second_order = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), 1,
+                          keep_dim=True), scale=0.5)
+    # deep part
+    deep = layers.reshape(emb, [-1, num_fields * embed_dim])
+    if dense_feat is not None:
+        deep = layers.concat([deep, dense_feat], axis=1)
+    for i, h in enumerate(hidden):
+        deep = layers.fc(deep, h, act="relu",
+                         param_attr=ParamAttr(name=f"deep_w{i}",
+                                              initializer=Normal(0.0, 0.01)))
+    deep_out = layers.fc(deep, 1, param_attr=ParamAttr(name="deep_out_w"))
+    logit = layers.elementwise_add(
+        layers.elementwise_add(first_order, second_order), deep_out)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit,
+                                                 layers.cast(label, "float32")))
+    prob = layers.sigmoid(logit)
+    pred_2c = layers.concat([layers.scale(prob, scale=-1.0, bias=1.0), prob],
+                            axis=1)
+    auc_var, _, auc_states = layers.auc(pred_2c, label)
+    return loss, auc_var, prob
+
+
+def ep_param_rules():
+    """Shard the big embedding tables over the 'ep' axis (rows = vocab)."""
+    return [(r"^fm_v$", ("ep", None)), (r"^fm_w1$", ("ep", None))]

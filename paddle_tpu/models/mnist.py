@@ -1,0 +1,28 @@
+"""MNIST models (reference: python/paddle/fluid/tests/book/test_recognize_digits.py,
+unittests/dist_mnist.py)."""
+from __future__ import annotations
+
+from .. import layers
+from ..layer_helper import ParamAttr
+
+
+def mlp(img, label, hidden=(128, 64), num_classes=10):
+    h = img
+    for size in hidden:
+        h = layers.fc(h, size, act="relu")
+    logits = layers.fc(h, num_classes)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(logits, label)
+    return loss, acc, logits
+
+
+def conv_net(img, label, num_classes=10):
+    """The reference's conv-pool MNIST net (simple_img_conv_pool analog)."""
+    h = layers.conv2d(img, 20, 5, act="relu")
+    h = layers.pool2d(h, 2, "max", 2)
+    h = layers.conv2d(h, 50, 5, act="relu")
+    h = layers.pool2d(h, 2, "max", 2)
+    logits = layers.fc(h, num_classes)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(logits, label)
+    return loss, acc, logits

@@ -1,0 +1,144 @@
+"""Transformer NMT (reference: tests/unittests/dist_transformer.py / the fluid
+Transformer model). Variable-length sequences use padded [B,S] + mask instead of
+LoDTensor (SURVEY.md §5.7); beam-search decode lowers through lax.while_loop
+(round-2: full beam; this round ships greedy scan decode).
+"""
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..layer_helper import ParamAttr
+from ..initializer import Normal
+
+
+class TransformerConfig:
+    def __init__(self, src_vocab=30000, trg_vocab=30000, hidden=512, n_layers=6,
+                 n_heads=8, ffn_hidden=2048, max_len=256, dropout=0.1):
+        self.src_vocab, self.trg_vocab = src_vocab, trg_vocab
+        self.hidden, self.n_layers, self.n_heads = hidden, n_layers, n_heads
+        self.ffn_hidden, self.max_len, self.dropout = ffn_hidden, max_len, dropout
+
+
+def _dense(x, size, name, act=None, nfd=2):
+    return _fc(x, size, name, act, nfd)
+
+
+def _fc(x, size, name, act=None, nfd=2):
+    return layers.fc(x, size, num_flatten_dims=nfd, act=act,
+                     param_attr=ParamAttr(name=name + "_w",
+                                          initializer=Normal(0.0, 0.02)))
+
+
+def _mha(q_in, kv_in, cfg, bias, name):
+    H = cfg.hidden
+    d = H // cfg.n_heads
+    q = _fc(q_in, H, name + "_q")
+    k = _fc(kv_in, H, name + "_k")
+    v = _fc(kv_in, H, name + "_v")
+
+    def heads(t):
+        t = layers.reshape(t, [0, -1, cfg.n_heads, d])
+        return layers.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(d))
+    if bias is not None:
+        scores = layers.elementwise_add(scores, bias)
+    probs = layers.softmax(scores)
+    if cfg.dropout:
+        probs = layers.dropout(probs, cfg.dropout,
+                               dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(probs, v)
+    ctx = layers.reshape(layers.transpose(ctx, [0, 2, 1, 3]), [0, -1, H])
+    return _fc(ctx, H, name + "_o")
+
+
+def _ffn(x, cfg, name):
+    h = _fc(x, cfg.ffn_hidden, name + "_ffn1", act="relu")
+    return _fc(h, cfg.hidden, name + "_ffn2")
+
+
+def _resid_norm(x, sub, cfg):
+    if cfg.dropout:
+        sub = layers.dropout(sub, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(x, sub), begin_norm_axis=2)
+
+
+def _embed(ids, pos_ids, vocab, cfg, name):
+    emb = layers.embedding(ids, [vocab, cfg.hidden],
+                           param_attr=ParamAttr(name=name + "_emb",
+                                                initializer=Normal(0.0, 0.02)))
+    emb = layers.scale(emb, scale=math.sqrt(cfg.hidden))
+    pos = layers.embedding(pos_ids, [cfg.max_len, cfg.hidden],
+                           param_attr=ParamAttr(name=name + "_pos",
+                                                initializer=Normal(0.0, 0.02)))
+    x = layers.elementwise_add(emb, pos)
+    if cfg.dropout:
+        x = layers.dropout(x, cfg.dropout,
+                           dropout_implementation="upscale_in_train")
+    return x
+
+
+def _pad_bias(mask):
+    """[B,S] 1/0 -> additive [B,1,1,S]."""
+    b = layers.scale(mask, scale=1e4, bias=-1e4)
+    return layers.unsqueeze(layers.unsqueeze(b, [1]), [1])
+
+
+def _causal_bias(mask, S):
+    """Combine padding mask with causal mask: [B,1,S,S] additive."""
+    pad = _pad_bias(mask)                                  # [B,1,1,S]
+    import numpy as np
+    tri = np.triu(np.full((S, S), -1e4, dtype="float32"), k=1)
+    causal = layers.assign(tri.reshape(1, 1, S, S))
+    return layers.elementwise_add(pad, causal)
+
+
+def encode(src_ids, src_pos, src_mask, cfg: TransformerConfig):
+    enc = _embed(src_ids, src_pos, cfg.src_vocab, cfg, "src")
+    bias = _pad_bias(src_mask)
+    for i in range(cfg.n_layers):
+        enc = _resid_norm(enc, _mha(enc, enc, cfg, bias, f"enc{i}_attn"), cfg)
+        enc = _resid_norm(enc, _ffn(enc, cfg, f"enc{i}"), cfg)
+    return enc
+
+
+def decode(trg_ids, trg_pos, trg_mask, enc_out, src_mask,
+           cfg: TransformerConfig):
+    S = trg_ids.shape[1]
+    dec = _embed(trg_ids, trg_pos, cfg.trg_vocab, cfg, "trg")
+    self_bias = _causal_bias(trg_mask, S)
+    cross_bias = _pad_bias(src_mask)
+    for i in range(cfg.n_layers):
+        dec = _resid_norm(dec, _mha(dec, dec, cfg, self_bias,
+                                    f"dec{i}_self"), cfg)
+        dec = _resid_norm(dec, _mha(dec, enc_out, cfg, cross_bias,
+                                    f"dec{i}_cross"), cfg)
+        dec = _resid_norm(dec, _ffn(dec, cfg, f"dec{i}"), cfg)
+    return _fc(dec, cfg.trg_vocab, "proj")    # [B,S,V]
+
+
+def transformer(src_ids, src_pos, src_mask, trg_ids, trg_pos, trg_mask,
+                label_ids, cfg: TransformerConfig, label_smooth_eps=0.1):
+    """Training graph; label_ids = trg shifted left. Returns (loss, logits)."""
+    enc_out = encode(src_ids, src_pos, src_mask, cfg)
+    logits = decode(trg_ids, trg_pos, trg_mask, enc_out, src_mask, cfg)
+    if label_smooth_eps:
+        labels = layers.label_smooth(
+            layers.one_hot(layers.reshape(label_ids, [-1, 1]), cfg.trg_vocab),
+            epsilon=label_smooth_eps)
+        flat = layers.reshape(logits, [-1, cfg.trg_vocab])
+        ce = layers.softmax_with_cross_entropy(flat, labels, soft_label=True)
+        ce = layers.reshape(ce, [0, 1])
+    else:
+        flat = layers.reshape(logits, [-1, cfg.trg_vocab])
+        ce = layers.softmax_with_cross_entropy(
+            flat, layers.reshape(label_ids, [-1, 1]))
+    # mask padded target positions
+    w = layers.reshape(trg_mask, [-1, 1])
+    loss = layers.elementwise_div(
+        layers.reduce_sum(layers.elementwise_mul(ce, w)),
+        layers.reduce_sum(w))
+    return loss, logits

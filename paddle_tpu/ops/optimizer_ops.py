@@ -4,14 +4,19 @@ Each op functionally rewrites Param (and moments) -- outputs alias the input sta
 by name, so under the executor's state threading + buffer donation XLA performs the
 update in place. All are grad=None (they sit after the backward section).
 
+Mixed precision discipline: every op computes in a single *master dtype* -- the dtype
+of its (f32) moment accumulators, or f32 when stateless -- by casting Param/Grad/LR up
+front (``_up``), doing the math with plain-Python hyperparameters (weak-typed, so they
+do not demote f32 arrays), and casting only ParamOut back to the parameter dtype
+(``_down``). This keeps bf16 params stable across steps (no dtype flips that would
+retrace) with f32 update math.
+
 The whole optimizer update for all params runs inside the same XLA program as
 forward/backward -- the reference's fuse_optimizer_ops_pass / coalesce_grad_tensor_pass
 (ir/fuse_optimizer_ops_pass/) exist to batch kernel launches, which XLA fusion already
 does, so there is nothing to fuse by hand here.
 """
 from __future__ import annotations
-
-import numpy as np
 
 from ..core.registry import register
 
@@ -21,43 +26,52 @@ def _jnp():
     return jnp
 
 
-def _f(x, ref):
-    """Cast update math to f32 then back to the param dtype."""
-    return x.astype("float32")
+def _up(mdt, *xs):
+    """Cast arrays up to the master dtype."""
+    return [x.astype(mdt) if x is not None else None for x in xs]
+
+
+def _down(p_out, p):
+    return p_out.astype(p.dtype)
 
 
 @register("sgd", grad=None)
 def sgd(ctx, ins):
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
-    return {"ParamOut": [(p - lr.astype(p.dtype) * g.astype(p.dtype)).astype(p.dtype)]}
+    mdt = "float32"
+    pf, gf, lrf = _up(mdt, p, g, lr)
+    return {"ParamOut": [_down(pf - lrf * gf, p)]}
 
 
 @register("momentum", grad=None)
 def momentum(ctx, ins):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
-    lr = ins["LearningRate"][0].astype(p.dtype)
-    mu = np.float32(ctx.attr("mu", 0.9)).astype(p.dtype)
-    v_out = mu * v + g
+    mdt = v.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
+    mu = ctx.attr("mu", 0.9)
+    v_out = mu * v + gf
     if ctx.attr("use_nesterov", False):
-        p_out = p - (g + mu * v_out) * lr
+        p_out = pf - (gf + mu * v_out) * lrf
     else:
-        p_out = p - lr * v_out
-    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+        p_out = pf - lrf * v_out
+    return {"ParamOut": [_down(p_out, p)], "VelocityOut": [v_out]}
 
 
 @register("lars_momentum", grad=None)
 def lars_momentum(ctx, ins):
     jnp = _jnp()
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
-    lr = ins["LearningRate"][0]
+    mdt = v.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     mu = ctx.attr("mu", 0.9)
     coeff = ctx.attr("lars_coeff", 0.001)
     decay = ctx.attr("lars_weight_decay", 0.0005)
-    pn = jnp.sqrt(jnp.sum(p * p))
-    gn = jnp.sqrt(jnp.sum(g * g))
-    local_lr = jnp.where(pn > 0, lr * coeff * pn / (gn + decay * pn + 1e-12), lr)
-    v_out = mu * v + local_lr * (g + decay * p)
-    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+    pn = jnp.sqrt(jnp.sum(pf * pf))
+    gn = jnp.sqrt(jnp.sum(gf * gf))
+    local_lr = jnp.where(pn > 0, lrf * coeff * pn / (gn + decay * pn + 1e-12),
+                         lrf)
+    v_out = mu * v + local_lr * (gf + decay * pf)
+    return {"ParamOut": [_down(pf - v_out, p)], "VelocityOut": [v_out]}
 
 
 @register("adam", grad=None)
@@ -66,16 +80,16 @@ def adam(ctx, ins):
     p, g = ins["Param"][0], ins["Grad"][0]
     m, v = ins["Moment1"][0], ins["Moment2"][0]
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
-    lr = ins["LearningRate"][0]
+    mdt = m.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     b1 = ctx.attr("beta1", 0.9)
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
-    gf = g.astype("float32")
     m_out = b1 * m + (1 - b1) * gf
     v_out = b2 * v + (1 - b2) * gf * gf
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
-    p_out = p.astype("float32") - lr_t * m_out / (jnp.sqrt(v_out) + eps)
-    return {"ParamOut": [p_out.astype(p.dtype)], "Moment1Out": [m_out],
+    lr_t = lrf * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = pf - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    return {"ParamOut": [_down(p_out, p)], "Moment1Out": [m_out],
             "Moment2Out": [v_out], "Beta1PowOut": [b1p * b1],
             "Beta2PowOut": [b2p * b2]}
 
@@ -86,17 +100,16 @@ def adamw(ctx, ins):
     p, g = ins["Param"][0], ins["Grad"][0]
     m, v = ins["Moment1"][0], ins["Moment2"][0]
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
-    lr = ins["LearningRate"][0]
+    mdt = m.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     wd = ctx.attr("coeff", 0.01)
-    gf = g.astype("float32")
     m_out = b1 * m + (1 - b1) * gf
     v_out = b2 * v + (1 - b2) * gf * gf
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
-    pf = p.astype("float32")
-    p_out = pf - lr_t * m_out / (jnp.sqrt(v_out) + eps) - lr * wd * pf
-    return {"ParamOut": [p_out.astype(p.dtype)], "Moment1Out": [m_out],
+    lr_t = lrf * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = pf - lr_t * m_out / (jnp.sqrt(v_out) + eps) - lrf * wd * pf
+    return {"ParamOut": [_down(p_out, p)], "Moment1Out": [m_out],
             "Moment2Out": [v_out], "Beta1PowOut": [b1p * b1],
             "Beta2PowOut": [b2p * b2]}
 
@@ -105,11 +118,12 @@ def adamw(ctx, ins):
 def adagrad(ctx, ins):
     jnp = _jnp()
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
-    lr = ins["LearningRate"][0]
+    mdt = mom.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     eps = ctx.attr("epsilon", 1e-6)
-    m_out = mom + g * g
-    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
-    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+    m_out = mom + gf * gf
+    p_out = pf - lrf * gf / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [_down(p_out, p)], "MomentOut": [m_out]}
 
 
 @register("adamax", grad=None)
@@ -118,26 +132,30 @@ def adamax(ctx, ins):
     p, g = ins["Param"][0], ins["Grad"][0]
     m, inf = ins["Moment"][0], ins["InfNorm"][0]
     b1p = ins["Beta1Pow"][0]
-    lr = ins["LearningRate"][0]
+    mdt = m.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
-    m_out = b1 * m + (1 - b1) * g
-    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
-    p_out = p - (lr / (1 - b1p)) * m_out / (inf_out + eps)
-    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out]}
+    m_out = b1 * m + (1 - b1) * gf
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(gf))
+    p_out = pf - (lrf / (1 - b1p)) * m_out / (inf_out + eps)
+    return {"ParamOut": [_down(p_out, p)], "MomentOut": [m_out],
+            "InfNormOut": [inf_out]}
 
 
 @register("adadelta", grad=None)
 def adadelta(ctx, ins):
     jnp = _jnp()
     p, g = ins["Param"][0], ins["Grad"][0]
-    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    asg_in, asu_in = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    mdt = asg_in.dtype
+    pf, gf = _up(mdt, p, g)
     rho = ctx.attr("rho", 0.95)
     eps = ctx.attr("epsilon", 1e-6)
-    asg = rho * avg_sq_g + (1 - rho) * g * g
-    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
-    asu = rho * avg_sq_u + (1 - rho) * update * update
-    return {"ParamOut": [p + update], "AvgSquaredGradOut": [asg],
+    asg = rho * asg_in + (1 - rho) * gf * gf
+    update = -jnp.sqrt((asu_in + eps) / (asg + eps)) * gf
+    asu = rho * asu_in + (1 - rho) * update * update
+    return {"ParamOut": [_down(pf + update, p)], "AvgSquaredGradOut": [asg],
             "AvgSquaredUpdateOut": [asu]}
 
 
@@ -146,19 +164,20 @@ def rmsprop(ctx, ins):
     jnp = _jnp()
     p, g = ins["Param"][0], ins["Grad"][0]
     ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
-    lr = ins["LearningRate"][0]
+    mdt = ms.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     eps = ctx.attr("epsilon", 1e-10)
     decay = ctx.attr("decay", 0.9)
     mu = ctx.attr("momentum", 0.0)
-    ms_out = decay * ms + (1 - decay) * g * g
+    ms_out = decay * ms + (1 - decay) * gf * gf
     if ctx.attr("centered", False):
         mg = ins["MeanGrad"][0]
-        mg_out = decay * mg + (1 - decay) * g
-        mom_out = mu * mom + lr * g / jnp.sqrt(ms_out - mg_out * mg_out + eps)
-        return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+        mg_out = decay * mg + (1 - decay) * gf
+        mom_out = mu * mom + lrf * gf / jnp.sqrt(ms_out - mg_out * mg_out + eps)
+        return {"ParamOut": [_down(pf - mom_out, p)], "MeanSquareOut": [ms_out],
                 "MomentOut": [mom_out], "MeanGradOut": [mg_out]}
-    mom_out = mu * mom + lr * g / jnp.sqrt(ms_out + eps)
-    return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+    mom_out = mu * mom + lrf * gf / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": [_down(pf - mom_out, p)], "MeanSquareOut": [ms_out],
             "MomentOut": [mom_out]}
 
 
@@ -167,17 +186,17 @@ def ftrl(ctx, ins):
     jnp = _jnp()
     p, g = ins["Param"][0], ins["Grad"][0]
     sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
-    lr = ins["LearningRate"][0]
+    mdt = sq.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)
     power = ctx.attr("lr_power", -0.5)
-    new_sq = sq + g * g
-    sigma = (new_sq ** -power - sq ** -power) / lr
-    lin_out = lin + g - sigma * p
+    new_sq = sq + gf * gf
+    sigma = (new_sq ** -power - sq ** -power) / lrf
+    lin_out = lin + gf - sigma * pf
     x = jnp.clip(lin_out, -l1, l1) - lin_out
-    y = new_sq ** -power / lr + 2 * l2
-    p_out = x / y
-    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+    y = new_sq ** -power / lrf + 2 * l2
+    return {"ParamOut": [_down(x / y, p)], "SquaredAccumOut": [new_sq],
             "LinearAccumOut": [lin_out]}
 
 
@@ -187,12 +206,11 @@ def lamb(ctx, ins):
     p, g = ins["Param"][0], ins["Grad"][0]
     m, v = ins["Moment1"][0], ins["Moment2"][0]
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
-    lr = ins["LearningRate"][0]
+    mdt = m.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-6)
     wd = ctx.attr("weight_decay", 0.01)
-    gf = g.astype("float32")
-    pf = p.astype("float32")
     m_out = b1 * m + (1 - b1) * gf
     v_out = b2 * v + (1 - b2) * gf * gf
     m_hat = m_out / (1 - b1p)
@@ -201,8 +219,7 @@ def lamb(ctx, ins):
     p_norm = jnp.sqrt(jnp.sum(pf * pf))
     r_norm = jnp.sqrt(jnp.sum(r * r))
     trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
-    p_out = pf - lr * trust * r
-    return {"ParamOut": [p_out.astype(p.dtype)], "Moment1Out": [m_out],
+    return {"ParamOut": [_down(pf - lrf * trust * r, p)], "Moment1Out": [m_out],
             "Moment2Out": [v_out], "Beta1PowOut": [b1p * b1],
             "Beta2PowOut": [b2p * b2]}
 
@@ -212,33 +229,35 @@ def dpsgd(ctx, ins):
     import jax
     jnp = _jnp()
     p, g = ins["Param"][0], ins["Grad"][0]
-    lr = ins["LearningRate"][0]
+    pf, gf, lrf = _up("float32", p, g, ins["LearningRate"][0])
     clip = ctx.attr("clip", 10.0)
     sigma = ctx.attr("sigma", 1.0)
-    gn = jnp.sqrt(jnp.sum(g * g))
-    g = g * jnp.minimum(1.0, clip / (gn + 1e-12))
-    noise = jax.random.normal(ctx.rng(), g.shape, dtype=g.dtype) * sigma * clip
-    return {"ParamOut": [p - lr * (g + noise)]}
+    gn = jnp.sqrt(jnp.sum(gf * gf))
+    gf = gf * jnp.minimum(1.0, clip / (gn + 1e-12))
+    noise = jax.random.normal(ctx.rng(), gf.shape, dtype=gf.dtype) * sigma * clip
+    return {"ParamOut": [_down(pf - lrf * (gf + noise), p)]}
 
 
 @register("proximal_gd", grad=None)
 def proximal_gd(ctx, ins):
     jnp = _jnp()
     p, g = ins["Param"][0], ins["Grad"][0]
-    lr = ins["LearningRate"][0]
+    pf, gf, lrf = _up("float32", p, g, ins["LearningRate"][0])
     l1, l2 = ctx.attr("l1", 0.0), ctx.attr("l2", 0.0)
-    prox = p - lr * g
-    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
-             / (1.0 + lr * l2))
-    return {"ParamOut": [p_out]}
+    prox = pf - lrf * gf
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lrf * l1, 0.0)
+             / (1.0 + lrf * l2))
+    return {"ParamOut": [_down(p_out, p)]}
 
 
 @register("decayed_adagrad", grad=None)
 def decayed_adagrad(ctx, ins):
     jnp = _jnp()
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
-    lr = ins["LearningRate"][0]
+    mdt = mom.dtype
+    pf, gf, lrf = _up(mdt, p, g, ins["LearningRate"][0])
     decay = ctx.attr("decay", 0.95)
     eps = ctx.attr("epsilon", 1e-6)
-    m_out = decay * mom + (1 - decay) * g * g
-    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_out) + eps)], "MomentOut": [m_out]}
+    m_out = decay * mom + (1 - decay) * gf * gf
+    return {"ParamOut": [_down(pf - lrf * gf / (jnp.sqrt(m_out) + eps), p)],
+            "MomentOut": [m_out]}

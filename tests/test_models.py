@@ -1,0 +1,186 @@
+"""Model-zoo tests: the five BASELINE configs build and train a step
+(analog of the reference's book/ model tests, scaled down)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import bert, deepfm, mnist, resnet, transformer
+
+
+def _run_steps(main, startup, feeds, fetches, steps=2):
+    exe = fluid.Executor()
+    outs = None
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            outs = exe.run(main, feed=feeds, fetch_list=fetches)
+    return outs
+
+
+def test_mnist_conv_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [1, 28, 28], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss, acc, _ = mnist.conv_net(img, label)
+        fluid.optimizer.Adam(0.001).minimize(loss)
+    rng = np.random.RandomState(0)
+    outs = _run_steps(main, startup,
+                      {"img": rng.randn(8, 1, 28, 28).astype("float32"),
+                       "label": rng.randint(0, 10, (8, 1)).astype("int64")},
+                      [loss, acc], steps=3)
+    assert np.isfinite(outs[0]).all()
+
+
+def test_resnet18_like_builds_and_steps():
+    """Small ResNet (stage depths cut) to keep CPU test time sane; same code path
+    as ResNet-50."""
+    resnet._DEPTHS[8] = [1, 1, 1, 1]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 32, 32], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss, acc, _ = resnet.resnet(img, label, depth=8, num_classes=10)
+        fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+    rng = np.random.RandomState(0)
+    outs = _run_steps(main, startup,
+                      {"img": rng.randn(4, 3, 32, 32).astype("float32"),
+                       "label": rng.randint(0, 10, (4, 1)).astype("int64")},
+                      [loss], steps=2)
+    assert np.isfinite(outs[0]).all()
+
+
+def _tiny_bert_cfg():
+    return bert.BertConfig(vocab_size=128, hidden=32, n_layers=2, n_heads=4,
+                           max_seq_len=16, dropout=0.1)
+
+
+def _bert_feeds(rng, B=4, S=16, M=6, vocab=128):
+    return {
+        "src_ids": rng.randint(0, vocab, (B, S)).astype("int64"),
+        "pos_ids": np.tile(np.arange(S), (B, 1)).astype("int64"),
+        "sent_ids": np.zeros((B, S), "int64"),
+        "input_mask": np.ones((B, S), "float32"),
+        "mask_pos": rng.randint(0, B * S, (M, 1)).astype("int64"),
+        "mask_label": rng.randint(0, vocab, (M, 1)).astype("int64"),
+        "nsp_label": rng.randint(0, 2, (B, 1)).astype("int64"),
+    }
+
+
+def test_bert_pretrain_builds_and_loss_decreases():
+    cfg = _tiny_bert_cfg()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.data("src_ids", [16], "int64")
+        pos = fluid.data("pos_ids", [16], "int64")
+        sent = fluid.data("sent_ids", [16], "int64")
+        mask = fluid.data("input_mask", [16], "float32")
+        mpos = fluid.data("mask_pos", [1], "int64")
+        mlabel = fluid.data("mask_label", [1], "int64")
+        nsp = fluid.data("nsp_label", [1], "int64")
+        total, mlm, nsp_acc = bert.pretrain(src, pos, sent, mask, mpos, mlabel,
+                                            nsp, cfg)
+        fluid.optimizer.Adam(0.005).minimize(total)
+    rng = np.random.RandomState(0)
+    feeds = _bert_feeds(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            lv, = exe.run(main, feed=feeds, fetch_list=[total])
+            losses.append(float(lv[0]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_tensor_parallel_runs():
+    """BERT with dp x mp sharding on the 8-device mesh."""
+    cfg = _tiny_bert_cfg()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.data("src_ids", [16], "int64")
+        pos = fluid.data("pos_ids", [16], "int64")
+        sent = fluid.data("sent_ids", [16], "int64")
+        mask = fluid.data("input_mask", [16], "float32")
+        mpos = fluid.data("mask_pos", [1], "int64")
+        mlabel = fluid.data("mask_label", [1], "int64")
+        nsp = fluid.data("nsp_label", [1], "int64")
+        total, _, _ = bert.pretrain(src, pos, sent, mask, mpos, mlabel, nsp, cfg)
+        fluid.optimizer.Adam(0.001).minimize(total)
+    strat = fluid.DistributedStrategy(
+        mesh_shape={"dp": 2, "mp": 4},
+        param_rules=bert.tp_param_rules(),
+        data_rules=[("mask_pos|mask_label", ())])  # masked-token dims not batch-sharded
+    cp = fluid.CompiledProgram(main).with_strategy(strat)
+    rng = np.random.RandomState(0)
+    feeds = _bert_feeds(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lv, = exe.run(cp, feed=feeds, fetch_list=[total])
+    assert np.isfinite(lv).all()
+
+
+def test_deepfm_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [8], "int64")
+        dense = fluid.data("dense", [4], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss, auc_var, prob = deepfm.deepfm(ids, dense, label, num_fields=8,
+                                            vocab_size=1000, embed_dim=8,
+                                            hidden=(32, 32))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    feeds = {"ids": rng.randint(0, 1000, (16, 8)).astype("int64"),
+             "dense": rng.randn(16, 4).astype("float32"),
+             "label": rng.randint(0, 2, (16, 1)).astype("int64")}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(10):
+            lv, aucv = exe.run(main, feed=feeds, fetch_list=[loss, auc_var])
+            losses.append(float(lv[0]))
+    assert losses[-1] < losses[0]
+    assert 0.0 <= float(aucv[0]) <= 1.0
+
+
+def test_transformer_nmt_trains():
+    cfg = transformer.TransformerConfig(src_vocab=64, trg_vocab=64, hidden=32,
+                                        n_layers=2, n_heads=4, ffn_hidden=64,
+                                        max_len=12, dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 4
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        S = 8
+        src = fluid.data("src", [S], "int64")
+        spos = fluid.data("spos", [S], "int64")
+        smask = fluid.data("smask", [S], "float32")
+        trg = fluid.data("trg", [S], "int64")
+        tpos = fluid.data("tpos", [S], "int64")
+        tmask = fluid.data("tmask", [S], "float32")
+        lbl = fluid.data("lbl", [S], "int64")
+        loss, logits = transformer.transformer(src, spos, smask, trg, tpos,
+                                               tmask, lbl, cfg,
+                                               label_smooth_eps=0.1)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    B, S = 4, 8
+    pos = np.tile(np.arange(S), (B, 1)).astype("int64")
+    feeds = {"src": rng.randint(0, 64, (B, S)).astype("int64"), "spos": pos,
+             "smask": np.ones((B, S), "float32"),
+             "trg": rng.randint(0, 64, (B, S)).astype("int64"), "tpos": pos,
+             "tmask": np.ones((B, S), "float32"),
+             "lbl": rng.randint(0, 64, (B, S)).astype("int64")}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(12):
+            lv, = exe.run(main, feed=feeds, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0], losses
