@@ -24,6 +24,16 @@ from .layer_helper import LayerHelper, ParamAttr, WeightNormParamAttr  # noqa
 from .layers.io import data  # noqa: F401
 from .compiler import (CompiledProgram, BuildStrategy, ExecutionStrategy,  # noqa
                        DistributedStrategy)
+from . import io  # noqa: F401
+from . import contrib  # noqa: F401
+from . import flags  # noqa: F401
+from . import profiler  # noqa: F401
+from . import debugger  # noqa: F401
+from .flags import get_flag, set_flags  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import reader  # noqa: F401
+from . import metrics  # noqa: F401
+from .reader import DataLoader, PyReader, DataFeeder  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -140,11 +140,20 @@ def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
             outs = d.lower(ctx, ins)
         except Exception as e:
             raise RuntimeError(f"lowering failed for op {op!r}: {e}") from e
+        from .. import flags as _flags
+        check_dtype = _flags.get_flag("check_dtype")
         for slot, names in op.outputs.items():
             vals = outs.get(slot, [])
             for i, n in enumerate(names):
                 if n == EMPTY_VAR or i >= len(vals) or vals[i] is None:
                     continue
+                if check_dtype:
+                    v = block.find_var_recursive(n)
+                    if v is not None and str(vals[i].dtype) != v.dtype:
+                        raise TypeError(
+                            f"op {op.type!r} wrote {n!r} as "
+                            f"{vals[i].dtype} but the program declares "
+                            f"{v.dtype} (would retrace every step)")
                 env[n] = vals[i]
     return env
 
@@ -234,9 +243,24 @@ class Executor:
         program._rng_run_counter = counter + 1
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
 
-        fetches, new_state = compiled.fn(mut_vals, ro_vals, feed_vals, rng)
+        from .. import flags as _flags
+        from .. import profiler as _profiler
+        cm = (_profiler.record_event(f"executor_run_v{program._version}")
+              if _flags.get_flag("profile_executor") else contextlib.nullcontext())
+        with cm:
+            fetches, new_state = compiled.fn(mut_vals, ro_vals, feed_vals, rng)
+            if _flags.get_flag("benchmark"):
+                jax.block_until_ready(new_state)
         for n, v in new_state.items():
             scope.set_var(n, v)
+        if _flags.get_flag("check_nan_inf"):
+            bad = [n for n, v in new_state.items()
+                   if np.issubdtype(np.asarray(v).dtype, np.floating) and
+                   not np.isfinite(np.asarray(v)).all()]
+            if bad:
+                raise FloatingPointError(
+                    f"NaN/Inf detected in state vars {bad[:5]} after run "
+                    f"(FLAGS_check_nan_inf)")
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

@@ -1,0 +1,7 @@
+"""Imperative mode (reference: python/paddle/fluid/dygraph/)."""
+from .base import (VarBase, to_variable, guard, no_grad, enabled,  # noqa
+                   trace_op, backward)
+from .nn import (Layer, Linear, FC, Conv2D, Pool2D, Embedding, BatchNorm,  # noqa
+                 LayerNorm, Dropout, Sequential)
+from .optimizer import SGDOptimizer, AdamOptimizer, MomentumOptimizer  # noqa
+from .checkpoint import save_dygraph, load_dygraph  # noqa: F401

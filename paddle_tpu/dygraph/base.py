@@ -1,0 +1,218 @@
+"""Imperative (dygraph) core: VarBase + tape tracer + eager autograd.
+
+Reference: paddle/fluid/imperative/ (Tracer tracer.h:31, VarBase layer.h:55,
+autograd Engine engine.h:35, GradientAccumulator) and python/paddle/fluid/dygraph/.
+
+TPU-native inversion (SURVEY.md §7 hard part 3): JAX is already eager, so dygraph ops
+execute the *same registry lowerings* immediately on device arrays; the tape records
+(op_type, attrs, inputs, outputs) and ``backward()`` replays it in reverse through the
+same vjp-based grad lowerings the static executor uses -- one op library, two modes.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import registry
+from ..core.registry import LowerCtx
+from ..framework import convert_dtype
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.tape: List[dict] = []
+        self.taping = True
+        self.op_counter = 0
+        self.seed = 0
+
+
+_state = _State()
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """``with fluid.dygraph.guard():`` (reference dygraph/base.py)."""
+    old = _state.enabled
+    _state.enabled = True
+    _state.tape = []
+    try:
+        yield
+    finally:
+        _state.enabled = old
+
+
+@contextlib.contextmanager
+def no_grad():
+    old = _state.taping
+    _state.taping = False
+    try:
+        yield
+    finally:
+        _state.taping = old
+
+
+class VarBase:
+    """Eager tensor with autograd slot (reference imperative/layer.h:55)."""
+
+    def __init__(self, value, stop_gradient=False, name=None):
+        import jax.numpy as jnp
+        if isinstance(value, VarBase):
+            value = value.value
+        self.value = value if hasattr(value, "dtype") and not isinstance(
+            value, np.ndarray) else jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.grad: Optional[object] = None
+
+    # -- info --------------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True, name=self.name)
+
+    def astype(self, dtype):
+        return trace_op("cast", {"X": [self]},
+                        {"out_dtype": convert_dtype(dtype)}, ["Out"])["Out"][0]
+
+    def backward(self):
+        backward(self)
+
+    def __repr__(self):
+        return f"VarBase({self.numpy()!r})"
+
+    # -- arithmetic --------------------------------------------------------------------
+    def _bin(self, other, op, reverse=False):
+        o = other if isinstance(other, VarBase) else VarBase(
+            np.asarray(other, dtype=self.numpy().dtype), stop_gradient=True)
+        x, y = (o, self) if reverse else (self, o)
+        return trace_op(op, {"X": [x], "Y": [y]}, {"axis": -1}, ["Out"])["Out"][0]
+
+    def __add__(self, o):
+        return self._bin(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._bin(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._bin(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._bin(o, "elementwise_div", reverse=True)
+
+    def __neg__(self):
+        return trace_op("scale", {"X": [self]}, {"scale": -1.0}, ["Out"])["Out"][0]
+
+
+def to_variable(value, name=None, zero_copy=None) -> VarBase:
+    """Reference dygraph/base.py:to_variable."""
+    return VarBase(value, name=name)
+
+
+def _ctx(attrs) -> LowerCtx:
+    import jax
+    _state.op_counter += 1
+    key = jax.random.PRNGKey(_state.seed)
+    return LowerCtx(attrs, key, _state.op_counter)
+
+
+def trace_op(op_type: str, ins: Dict[str, List[VarBase]], attrs: dict,
+             out_slots: List[str]) -> Dict[str, List[VarBase]]:
+    """Run an op eagerly and record it on the tape (reference Tracer::TraceOp)."""
+    d = registry.get(op_type)
+    raw_ins = {s: [v.value if v is not None else None for v in vs]
+               for s, vs in ins.items()}
+    outs = d.lower(_ctx(attrs), raw_ins)
+    out_vars: Dict[str, List[VarBase]] = {}
+    stop_all = all(v is None or v.stop_gradient
+                   for vs in ins.values() for v in vs)
+    for s in out_slots:
+        vals = outs.get(s, [])
+        out_vars[s] = [VarBase(v, stop_gradient=stop_all or d.grad is None)
+                       if v is not None else None for v in vals]
+    if _state.taping and not stop_all and d.grad is not None:
+        _state.tape.append({"type": op_type, "attrs": dict(attrs),
+                            "ins": {s: list(vs) for s, vs in ins.items()},
+                            "outs": {s: list(vs)
+                                     for s, vs in out_vars.items()}})
+    return out_vars
+
+
+def backward(loss: VarBase):
+    """Reverse tape walk through the same vjp grad lowerings
+    (reference imperative::BasicEngine)."""
+    import jax.numpy as jnp
+
+    grads: Dict[int, object] = {id(loss): jnp.ones_like(loss.value)}
+
+    for entry in reversed(_state.tape):
+        out_grads_present = False
+        grad_ins = {}
+        for s, vs in entry["ins"].items():
+            grad_ins[s] = [v.value if v is not None else None for v in vs]
+        for s, vs in entry["outs"].items():
+            grad_ins[s] = [v.value if v is not None else None for v in vs]
+            g = [grads.get(id(v)) if v is not None else None for v in vs]
+            if any(x is not None for x in g):
+                out_grads_present = True
+                grad_ins[s + "@GRAD"] = g
+        if not out_grads_present:
+            continue
+        d = registry.get(entry["type"] + "_grad")
+        attrs = dict(entry["attrs"])
+        attrs["__fwd_out_slots__"] = sorted(entry["outs"])
+        result = d.lower(_ctx(attrs), grad_ins)
+        for s, vs in entry["ins"].items():
+            gvals = result.get(s + "@GRAD")
+            if gvals is None:
+                continue
+            for v, g in zip(vs, gvals):
+                if v is None or g is None or v.stop_gradient:
+                    continue
+                prev = grads.get(id(v))
+                grads[id(v)] = g if prev is None else prev + g
+
+    # deposit into .grad on leaf VarBases (params)
+    seen = set()
+    for entry in _state.tape:
+        for vs in entry["ins"].values():
+            for v in vs:
+                if v is None or id(v) in seen:
+                    continue
+                seen.add(id(v))
+                g = grads.get(id(v))
+                if g is not None and not v.stop_gradient:
+                    v.grad = g if v.grad is None else v.grad + g
+    _state.tape = []

@@ -1,0 +1,17 @@
+"""Dygraph state-dict save/load (reference: python/paddle/fluid/dygraph/checkpoint.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def save_dygraph(state_dict: dict, model_path: str):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + ".npz",
+             **{k: np.asarray(v) for k, v in state_dict.items()})
+
+
+def load_dygraph(model_path: str):
+    data = np.load(model_path + ".npz", allow_pickle=False)
+    return {k: data[k] for k in data.files}, None

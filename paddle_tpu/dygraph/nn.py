@@ -1,0 +1,278 @@
+"""Dygraph Layer classes (reference: python/paddle/fluid/dygraph/nn.py:
+Conv2D:35, Pool2D:759, FC:919, BatchNorm, Embedding, LayerNorm, ...)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import unique_name
+from ..framework import convert_dtype
+from .base import VarBase, trace_op, no_grad
+
+
+def _init_array(shape, dtype, initializer, fan_in=None, seed=0):
+    import jax
+    rng = np.random.RandomState(seed + abs(hash(tuple(shape))) % 100000)
+    if initializer == "zeros":
+        return np.zeros(shape, dtype)
+    if initializer == "ones":
+        return np.ones(shape, dtype)
+    if initializer == "xavier":
+        if len(shape) >= 2:
+            fin = int(np.prod(shape[1:])) if len(shape) > 2 else shape[0]
+            fout = shape[0] if len(shape) > 2 else shape[1]
+        else:
+            fin = fout = shape[0] if shape else 1
+        limit = np.sqrt(6.0 / (fin + fout))
+        return rng.uniform(-limit, limit, shape).astype(dtype)
+    if initializer == "normal":
+        return (rng.randn(*shape) * 0.02).astype(dtype)
+    raise ValueError(initializer)
+
+
+class Layer:
+    """Reference dygraph/layers.py Layer."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._dtype = convert_dtype(dtype)
+        self._parameters: Dict[str, VarBase] = {}
+        self._sub_layers: Dict[str, "Layer"] = {}
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    def create_parameter(self, shape, dtype=None, initializer="xavier",
+                         is_bias=False, name=None) -> VarBase:
+        dtype = convert_dtype(dtype or self._dtype)
+        if is_bias and initializer == "xavier":
+            initializer = "zeros"
+        arr = _init_array(tuple(int(s) for s in shape), dtype, initializer)
+        p = VarBase(arr, stop_gradient=False,
+                    name=name or unique_name.generate(
+                        self._full_name + (".b" if is_bias else ".w")))
+        key = p.name
+        self._parameters[key] = p
+        return p
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+    def parameters(self, include_sublayers=True) -> List[VarBase]:
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for k, p in self._parameters.items():
+            yield (prefix + k, p)
+        for n, l in self._sub_layers.items():
+            yield from l.named_parameters(prefix + n + ".")
+
+    def sublayers(self):
+        return list(self._sub_layers.values())
+
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+
+    def state_dict(self):
+        return {n: p.numpy() for n, p in self.named_parameters()}
+
+    def set_dict(self, state, use_structured_name=True):
+        import jax.numpy as jnp
+        named = dict(self.named_parameters())
+        for n, v in state.items():
+            if n in named:
+                named[n].value = jnp.asarray(v)
+
+    load_dict = set_dict
+
+    def __call__(self, *args, **kw):
+        return self.forward(*args, **kw)
+
+    def forward(self, *args, **kw):
+        raise NotImplementedError
+
+
+class Linear(Layer):
+    """Reference dygraph FC (nn.py:919) / Linear."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([input_dim, output_dim])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([output_dim], is_bias=True))
+        self._act = act
+
+    def forward(self, x):
+        out = trace_op("mul", {"X": [x], "Y": [self.weight]},
+                       {"x_num_col_dims": len(x.shape) - 1,
+                        "y_num_col_dims": 1}, ["Out"])["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": -1}, ["Out"])["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out
+
+
+FC = Linear
+
+
+class Conv2D(Layer):
+    """Reference dygraph/nn.py:35."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fh, fw = (filter_size if isinstance(filter_size, (list, tuple))
+                  else (filter_size, filter_size))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // (groups or 1), fh, fw])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([num_filters], is_bias=True))
+        self._attrs = {
+            "strides": [stride, stride] if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding, padding] if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation, dilation] if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups or 1}
+        self._act = act
+
+    def forward(self, x):
+        out = trace_op("conv2d", {"Input": [x], "Filter": [self.weight]},
+                       self._attrs, ["Output"])["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": 1}, ["Out"])["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out
+
+
+class Pool2D(Layer):
+    """Reference dygraph/nn.py:759."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size, pool_size] if isinstance(pool_size, int)
+            else list(pool_size),
+            "strides": [pool_stride, pool_stride]
+            if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding, pool_padding]
+            if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling}
+
+    def forward(self, x):
+        return trace_op("pool2d", {"X": [x]}, self._attrs, ["Out"])["Out"][0]
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(list(size), initializer="normal")
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, ids):
+        return trace_op("lookup_table_v2",
+                        {"W": [self.weight], "Ids": [ids]},
+                        {"padding_idx": self._padding_idx}, ["Out"])["Out"][0]
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 dtype="float32", data_layout="NCHW"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([num_channels],
+                                            initializer="ones")
+        self.bias = self.create_parameter([num_channels], is_bias=True)
+        self._mean = VarBase(np.zeros([num_channels], "float32"),
+                             stop_gradient=True)
+        self._variance = VarBase(np.ones([num_channels], "float32"),
+                                 stop_gradient=True)
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "data_layout": data_layout}
+        self._act = act
+
+    def forward(self, x):
+        attrs = dict(self._attrs, is_test=not self.training)
+        outs = trace_op(
+            "batch_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            attrs, ["Y", "MeanOut", "VarianceOut"])
+        if self.training:
+            with no_grad():
+                self._mean = outs["MeanOut"][0].detach()
+                self._variance = outs["VarianceOut"][0].detach()
+        y = outs["Y"][0]
+        if self._act:
+            y = trace_op(self._act, {"X": [y]}, {}, ["Out"])["Out"][0]
+        return y
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.weight = self.create_parameter(list(normalized_shape),
+                                            initializer="ones")
+        self.bias = self.create_parameter(list(normalized_shape), is_bias=True)
+        self._epsilon = epsilon
+
+    def forward(self, x):
+        return trace_op(
+            "layer_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            {"epsilon": self._epsilon, "begin_norm_axis": len(x.shape) - 1},
+            ["Y"])["Y"][0]
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._p = p
+
+    def forward(self, x):
+        return trace_op("dropout", {"X": [x]},
+                        {"dropout_prob": self._p,
+                         "is_test": not self.training,
+                         "dropout_implementation": "upscale_in_train"},
+                        ["Out"])["Out"][0]
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        for i, l in enumerate(layers):
+            setattr(self, f"l{i}", l)
+        self._order = [f"l{i}" for i in range(len(layers))]
+
+    def forward(self, x):
+        for n in self._order:
+            x = self._sub_layers[n](x)
+        return x

@@ -1,0 +1,90 @@
+"""Dygraph optimizers: eager updates through the same optimizer-op lowerings.
+
+Reference: fluid optimizers used under dygraph.guard call the C++ kernels
+imperatively; here minimize(loss) = tape backward + per-param update via the
+registered sgd/adam/momentum lowerings, so static and eager share update math.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..core import registry
+from ..core.registry import LowerCtx
+from .base import VarBase, backward
+
+
+class DygraphOptimizer:
+    def __init__(self, learning_rate):
+        self._lr = learning_rate
+        self._state: Dict[int, dict] = {}
+
+    def _lr_arr(self):
+        import jax.numpy as jnp
+        return jnp.asarray([float(self._lr)], "float32")
+
+    def minimize(self, loss: VarBase, parameter_list: List[VarBase] = None):
+        backward(loss)
+        params = parameter_list or []
+        for p in params:
+            if p.grad is None:
+                continue
+            self._apply(p)
+            p.clear_gradient()
+        return None, None
+
+    def _apply(self, p: VarBase):
+        raise NotImplementedError
+
+
+class SGDOptimizer(DygraphOptimizer):
+    def _apply(self, p):
+        d = registry.get("sgd")
+        outs = d.lower(LowerCtx({}), {"Param": [p.value], "Grad": [p.grad],
+                                      "LearningRate": [self._lr_arr()]})
+        p.value = outs["ParamOut"][0]
+
+
+class MomentumOptimizer(DygraphOptimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False):
+        super().__init__(learning_rate)
+        self._mu = momentum
+        self._nesterov = use_nesterov
+
+    def _apply(self, p):
+        import jax.numpy as jnp
+        st = self._state.setdefault(id(p), {
+            "velocity": jnp.zeros(p.shape, "float32")})
+        d = registry.get("momentum")
+        outs = d.lower(
+            LowerCtx({"mu": self._mu, "use_nesterov": self._nesterov}),
+            {"Param": [p.value], "Grad": [p.grad],
+             "Velocity": [st["velocity"]], "LearningRate": [self._lr_arr()]})
+        p.value = outs["ParamOut"][0]
+        st["velocity"] = outs["VelocityOut"][0]
+
+
+class AdamOptimizer(DygraphOptimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        super().__init__(learning_rate)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _apply(self, p):
+        import jax.numpy as jnp
+        st = self._state.setdefault(id(p), {
+            "m1": jnp.zeros(p.shape, "float32"),
+            "m2": jnp.zeros(p.shape, "float32"),
+            "b1p": jnp.asarray([self._b1], "float32"),
+            "b2p": jnp.asarray([self._b2], "float32")})
+        d = registry.get("adam")
+        outs = d.lower(
+            LowerCtx({"beta1": self._b1, "beta2": self._b2,
+                      "epsilon": self._eps}),
+            {"Param": [p.value], "Grad": [p.grad], "Moment1": [st["m1"]],
+             "Moment2": [st["m2"]], "Beta1Pow": [st["b1p"]],
+             "Beta2Pow": [st["b2p"]], "LearningRate": [self._lr_arr()]})
+        p.value = outs["ParamOut"][0]
+        st["m1"], st["m2"] = outs["Moment1Out"][0], outs["Moment2Out"][0]
+        st["b1p"], st["b2p"] = outs["Beta1PowOut"][0], outs["Beta2PowOut"][0]

@@ -1,0 +1,105 @@
+"""Typed flag/config system with FLAGS_* env override.
+
+Reference: ~135 gflags in paddle/fluid/platform/flags.cc re-exported to Python via
+the env-var bridge (python/paddle/fluid/__init__.py:162-216, core.init_gflags).
+Here: one typed registry, values read from FLAGS_<name> env vars at import and
+settable at runtime. Flags that map to XLA/JAX behavior apply themselves; purely
+CUDA-era flags are accepted for port compatibility and ignored (listed as such).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+
+class _Flag:
+    def __init__(self, name: str, default, typ, help: str, on_set=None,
+                 noop: bool = False):
+        self.name = name
+        self.default = default
+        self.typ = typ
+        self.help = help
+        self.on_set = on_set
+        self.noop = noop
+        self.value = default
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _parse(typ, s: str):
+    if typ is bool:
+        return s.lower() in ("1", "true", "yes", "on")
+    return typ(s)
+
+
+def define_flag(name: str, default, typ=None, help: str = "", on_set=None,
+                noop: bool = False):
+    typ = typ or type(default)
+    f = _Flag(name, default, typ, help, on_set, noop)
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        f.value = _parse(typ, env)
+    _REGISTRY[name] = f
+    if f.on_set and f.value != f.default:
+        f.on_set(f.value)
+    return f
+
+
+def get_flag(name: str):
+    return _REGISTRY[name].value
+
+
+def set_flag(name: str, value):
+    f = _REGISTRY[name]
+    f.value = _parse(f.typ, str(value)) if not isinstance(value, f.typ) else value
+    if f.on_set:
+        f.on_set(f.value)
+
+
+def set_flags(d: Dict[str, Any]):
+    for k, v in d.items():
+        set_flag(k.replace("FLAGS_", ""), v)
+
+
+def list_flags():
+    return {n: f.value for n, f in _REGISTRY.items()}
+
+
+def _apply_debug_nans(v):
+    try:
+        import jax
+        jax.config.update("jax_debug_nans", bool(v))
+    except Exception:
+        pass
+
+
+# -- live flags (map to real behavior) -------------------------------------------------
+define_flag("check_nan_inf", False, bool,
+            "check every op output for NaN/Inf (reference operator.cc:949; maps "
+            "to jax_debug_nans + executor state checks)", on_set=_apply_debug_nans)
+define_flag("check_dtype", False, bool,
+            "assert op outputs match declared VarDesc dtypes at trace time")
+define_flag("benchmark", False, bool,
+            "block_until_ready after every executor run for stable timing "
+            "(reference FLAGS_benchmark forced per-op dev_ctx->Wait())")
+define_flag("executor_cache_capacity", 64, int,
+            "LRU capacity of the executor compile cache")
+define_flag("profile_executor", False, bool,
+            "record per-run wall time in profiler aggregate table")
+
+# -- accepted no-ops (CUDA-era knobs kept so ported scripts run unchanged) -------------
+for _name, _default in [
+    ("fraction_of_gpu_memory_to_use", 0.92), ("eager_delete_tensor_gb", 0.0),
+    ("memory_fraction_of_eager_deletion", 1.0), ("allocator_strategy", "auto"),
+    ("cudnn_deterministic", False), ("cudnn_exhaustive_search", False),
+    ("enable_cublas_tensor_op_math", False), ("conv_workspace_size_limit", 512),
+    ("cpu_deterministic", False), ("paddle_num_threads", 1),
+    ("use_pinned_memory", True), ("init_allocated_mem", False),
+    ("free_idle_memory", False), ("fuse_parameter_memory_size", -1),
+    ("rpc_deadline", 180000), ("rpc_retry_times", 3),
+]:
+    define_flag(_name, _default,
+                help="accepted for fluid port compatibility; no-op under "
+                     "XLA/PJRT (memory, cuDNN and RPC runtimes are subsumed)",
+                noop=True)

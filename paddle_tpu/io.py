@@ -1,0 +1,190 @@
+"""Checkpoint / save-load / inference-model export.
+
+Reference: python/paddle/fluid/io.py (save_params:259, save_persistables:509,
+load_params:730, load_persistables:787, save_inference_model:997,
+load_inference_model:1201).
+
+Format (TPU-native, not the reference's binary): one ``<name>.npy`` per var plus a
+``__model__.json`` Program for inference models. Sharded SPMD params are gathered to
+host on save; on load the next jitted run re-shards them per the active strategy
+(reshard-on-load, SURVEY.md §5.4). bfloat16 is stored as uint16 with a sidecar flag.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import Executor, Scope, global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+
+def _to_numpy(val):
+    arr = np.asarray(val)
+    if str(arr.dtype) == "bfloat16":
+        return arr.view(np.uint16), "bfloat16"
+    return arr, str(arr.dtype)
+
+
+def _save_var(dirname, name, val):
+    arr, dtype = _to_numpy(val)
+    path = os.path.join(dirname, name.replace("/", "__"))
+    np.save(path + ".npy", arr, allow_pickle=False)
+    return {"name": name, "dtype": dtype, "file": os.path.basename(path) + ".npy"}
+
+
+def _load_var(dirname, meta):
+    arr = np.load(os.path.join(dirname, meta["file"]), allow_pickle=False)
+    if meta["dtype"] == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
+    return arr
+
+
+def save_vars(executor, dirname, main_program=None, vars: Optional[List] = None,
+              predicate=None, filename=None):
+    """Reference io.py:save_vars. ``filename`` accepted for parity (single-file
+    format stores the manifest under that name)."""
+    main_program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if (predicate is None or predicate(v))]
+    os.makedirs(dirname, exist_ok=True)
+    manifest = []
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError(f"variable {name!r} has no value in scope; "
+                               f"run the startup program before saving")
+        manifest.append(_save_var(dirname, name, val))
+    with open(os.path.join(dirname, filename or "__manifest__.json"), "w") as f:
+        json.dump({"vars": manifest}, f)
+
+
+def _is_param(v):
+    return isinstance(v, Parameter)
+
+
+def _is_persistable(v):
+    return v.persistable and not v.is_data
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """Parameters only (no optimizer state) -- reference io.py:259."""
+    save_vars(executor, dirname, main_program, predicate=_is_param,
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Everything needed to resume training (params + optimizer moments + bn
+    stats + LR counters) -- reference io.py:509."""
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    main_program = main_program or default_main_program()
+    scope = global_scope()
+    with open(os.path.join(dirname, filename or "__manifest__.json")) as f:
+        manifest = {m["name"]: m for m in json.load(f)["vars"]}
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if (predicate is None or predicate(v))]
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        if name not in manifest:
+            raise RuntimeError(f"checkpoint at {dirname} has no variable "
+                               f"{name!r}")
+        val = _load_var(dirname, manifest[name])
+        if isinstance(v, Variable) and v.shape:
+            declared = tuple(v.shape)
+            mismatch = (len(val.shape) != len(declared) or
+                        any(d != -1 and d != s
+                            for d, s in zip(declared, val.shape)))
+            if mismatch:
+                raise RuntimeError(
+                    f"shape mismatch loading {name!r}: checkpoint "
+                    f"{tuple(val.shape)} vs program {declared}")
+        scope.set_var(name, val)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_param,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+# --------------------------------------------------------------------------------------
+# inference model export (reference io.py:997 save_inference_model)
+# --------------------------------------------------------------------------------------
+
+def _prune(program: Program, feed_names: Sequence[str],
+           target_names: Sequence[str]) -> Program:
+    """Slice the program to the subgraph producing targets from feeds
+    (reference framework/prune.cc)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(target_names)
+    keep = []
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if any(n in needed for n in op.output_arg_names()):
+            keep.append(i)
+            needed.update(op.input_arg_names())
+    keep = set(keep)
+    block.ops = [op for i, op in enumerate(block.ops) if i in keep]
+    # drop vars not referenced anymore
+    referenced = set(feed_names) | set(target_names)
+    for op in block.ops:
+        referenced.update(op.input_arg_names())
+        referenced.update(op.output_arg_names())
+    block.vars = {n: v for n, v in block.vars.items() if n in referenced}
+    pruned._bump()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """Reference io.py:997: prune to the inference subgraph + save params.
+    Returns the target var names (parity with the reference's return)."""
+    main_program = main_program or default_main_program()
+    target_names = [t.name if isinstance(t, Variable) else str(t)
+                    for t in target_vars]
+    pruned = _prune(main_program, feeded_var_names, target_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = {"program": pruned.to_dict(), "feed_names": list(feeded_var_names),
+             "fetch_names": target_names}
+    with open(os.path.join(dirname, model_filename or "__model__.json"),
+              "w") as f:
+        json.dump(model, f)
+    params = [v for v in pruned.list_vars() if isinstance(
+        main_program.global_block().vars.get(v.name), Parameter) or
+        (v.persistable and not v.is_data)]
+    save_vars(executor, dirname, pruned, vars=params,
+              filename=params_filename)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Reference io.py:1201. Returns (program, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    scope = global_scope()
+    with open(os.path.join(dirname, params_filename or
+                           "__manifest__.json")) as f:
+        manifest = json.load(f)["vars"]
+    for m in manifest:
+        scope.set_var(m["name"], _load_var(dirname, m))
+    return program, model["feed_names"], model["fetch_names"]

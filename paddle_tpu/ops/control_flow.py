@@ -103,6 +103,31 @@ def scan_op(ctx, ins):
     return {"Out": outs, "FinalCarry": [final_carry[n] for n in carry_names]}
 
 
+@register("remat_segment")
+def remat_segment(ctx, ins):
+    """Rematerialized forward segment (the RecomputeOptimizer unit,
+    reference optimizer.py:3278 + backward.py:576).
+
+    The segment's ops live in a sub-block; the lowering wraps its execution in
+    jax.checkpoint, so the generic vjp grad recomputes the segment's
+    intermediates in backward instead of storing them -- true rematerialization
+    (XLA cannot CSE across the checkpoint barrier).
+    """
+    import jax
+
+    sub_idx = ctx.attr("sub_block")
+    in_names = list(ctx.attr("in_names", []))
+    out_names = list(ctx.attr("out_names", []))
+
+    def f(xs):
+        env = dict(zip(in_names, xs))
+        env = ctx.block_runner(sub_idx, env)
+        return [env[n] for n in out_names]
+
+    outs = jax.checkpoint(f)(list(ins["X"]))
+    return {"Out": list(outs)}
+
+
 @register("print", grad="auto")
 def print_op(ctx, ins):
     """Debug print (reference print_op.cc / lodtensor_printer): host callback."""

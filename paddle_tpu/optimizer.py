@@ -6,7 +6,7 @@ compiles to a single XLA program (reference splits this across executors/op hand
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -383,6 +383,311 @@ class DpsgdOptimizer(Optimizer):
             outputs={"ParamOut": [p]},
             attrs={"clip": self._clip, "batch_size": self._batch_size,
                    "sigma": self._sigma})
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation rematerialization (reference optimizer.py:3278).
+
+    ``_set_checkpoints([vars])`` marks segment boundaries; minimize() moves each
+    inter-checkpoint forward segment into a sub-block executed under
+    jax.checkpoint (see ops/control_flow.py remat_segment), then delegates to the
+    inner optimizer. Backward recomputes segment intermediates instead of
+    storing them. Note: vars internal to a segment can no longer be fetched.
+    """
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+        return self
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program, parameter_list,
+                                        no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if not self._checkpoints:
+            raise ValueError("call _set_checkpoints() before minimize()")
+        program = loss.block.program
+        _rewrite_recompute(program,
+                           [c.name if isinstance(c, Variable) else str(c)
+                            for c in self._checkpoints])
+        loss = program.global_block().var(loss.name)
+        return self._optimizer.minimize(loss, startup_program, parameter_list,
+                                        no_grad_set)
+
+
+def _rewrite_recompute(program: Program, checkpoint_names):
+    """Partition forward ops at checkpoint producers into remat_segment ops."""
+    block = program.global_block()
+    ops = block.ops
+    ckpts = set(checkpoint_names)
+
+    # segment boundaries: index just after an op that produces a checkpoint var
+    boundaries = [0]
+    for i, op in enumerate(ops):
+        if any(n in ckpts for n in op.output_arg_names()):
+            boundaries.append(i + 1)
+    segments = [(a, b) for a, b in zip(boundaries, boundaries[1:]) if b - a >= 2]
+    if not segments:
+        return
+
+    produced_after: Dict[int, set] = {}
+    new_ops = []
+    cursor = 0
+    for (a, b) in segments:
+        new_ops.extend(ops[cursor:a])
+        seg_ops = ops[a:b]
+        # io analysis
+        produced = set()
+        read = []
+        for op in seg_ops:
+            for n in op.input_arg_names():
+                if n not in produced and n not in read:
+                    read.append(n)
+            produced.update(op.output_arg_names())
+        used_later = set()
+        for op in ops[b:]:
+            used_later.update(op.input_arg_names())
+        out_names = []
+        for op in seg_ops:
+            for n in op.output_arg_names():
+                v = block.find_var_recursive(n)
+                if n in out_names:
+                    continue
+                if n in used_later or n in ckpts or (v is not None and
+                                                     v.persistable):
+                    out_names.append(n)
+        in_names = [n for n in read
+                    if block.find_var_recursive(n) is not None]
+        sub = program._create_block(parent_idx=0)
+        sub.ops = list(seg_ops)
+        program._rollback()
+        from .framework import Operator
+        seg_op = Operator(block, "remat_segment",
+                          {"X": in_names}, {"Out": out_names},
+                          {"sub_block": sub.idx, "in_names": in_names,
+                           "out_names": out_names})
+        new_ops.append(seg_op)
+        cursor = b
+    new_ops.extend(ops[cursor:])
+    block.ops = new_ops
+    program._bump()
+
+
+class ExponentialMovingAverage:
+    """EMA shadow parameters (reference optimizer.py:2449).
+
+    ``update()`` appends in-graph EMA ops (call after minimize); ``apply()`` /
+    ``restore()`` swap param values in the scope host-side.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+
+    def update(self):
+        from .framework import default_main_program
+        from .initializer import Constant
+        block = default_main_program().global_block()
+        helper = LayerHelper("ema")
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            shadow = helper.create_global_variable(
+                list(p.shape), "float32", persistable=True,
+                name=unique_name.generate(p.name + "_ema"),
+                initializer=Constant(0.0))
+            self._shadow[p.name] = shadow.name
+            tmp = block.create_var(unique_name.generate("ema_t"), p.shape,
+                                   "float32")
+            block.append_op("scale", inputs={"X": [shadow.name]},
+                            outputs={"Out": [tmp]},
+                            attrs={"scale": self._decay})
+            tmp2 = block.create_var(unique_name.generate("ema_t"), p.shape,
+                                    "float32")
+            block.append_op("scale", inputs={"X": [p.name]},
+                            outputs={"Out": [tmp2]},
+                            attrs={"scale": 1.0 - self._decay})
+            block.append_op("sum", inputs={"X": [tmp, tmp2]},
+                            outputs={"Out": [shadow.name]})
+
+    def apply(self, executor=None, need_restore=True):
+        from .core.executor import global_scope
+        scope = global_scope()
+        for pname, sname in self._shadow.items():
+            self._backup[pname] = scope.find_var(pname)
+            val = scope.find_var(sname)
+            if val is not None:
+                scope.set_var(pname, val)
+        ema = self
+
+        class _Guard:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ema.restore()
+                return False
+
+        return _Guard()
+
+    def restore(self, executor=None):
+        from .core.executor import global_scope
+        scope = global_scope()
+        for pname, val in self._backup.items():
+            scope.set_var(pname, val)
+        self._backup = {}
+
+
+class ModelAverage:
+    """Sliding-window parameter averaging (reference optimizer.py:2751).
+
+    Simplification vs the reference's 3-tier sum buffers: one running sum +
+    count per param with the same apply/restore surface; the window knobs bound
+    when the accumulator restarts.
+    """
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000000):
+        self._max_window = max_average_window
+        self._sums = {}
+        self._backup = {}
+
+    def _build(self):
+        from .framework import default_main_program
+        from .initializer import Constant
+        block = default_main_program().global_block()
+        helper = LayerHelper("model_average")
+        count = helper.create_global_variable(
+            [1], "float32", persistable=True,
+            name=unique_name.generate("ma_count"), initializer=Constant(0.0))
+        block.append_op("increment", inputs={"X": [count.name]},
+                        outputs={"Out": [count.name]}, attrs={"step": 1.0})
+        self._count = count.name
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            s = helper.create_global_variable(
+                list(p.shape), "float32", persistable=True,
+                name=unique_name.generate(p.name + "_ma_sum"),
+                initializer=Constant(0.0))
+            self._sums[p.name] = s.name
+            block.append_op("sum", inputs={"X": [s.name, p.name]},
+                            outputs={"Out": [s.name]})
+
+    def update(self):
+        if not self._sums:
+            self._build()
+
+    def apply(self, executor=None, need_restore=True):
+        import numpy as np
+        from .core.executor import global_scope
+        scope = global_scope()
+        cnt = float(np.asarray(scope.find_var(self._count)).reshape(-1)[0])
+        for pname, sname in self._sums.items():
+            self._backup[pname] = scope.find_var(pname)
+            s = scope.find_var(sname)
+            if s is not None and cnt > 0:
+                scope.set_var(pname, s / cnt)
+        ma = self
+
+        class _Guard:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ma.restore()
+                return False
+
+        return _Guard()
+
+    def restore(self, executor=None):
+        from .core.executor import global_scope
+        scope = global_scope()
+        for pname, val in self._backup.items():
+            scope.set_var(pname, val)
+        self._backup = {}
+
+
+class LookaheadOptimizer:
+    """Lookahead k-step slow/fast weights (reference optimizer.py:3571)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        from .framework import program_guard, default_startup_program
+        from .initializer import Constant
+        from .layers import nn, tensor
+
+        ops, pg = self.inner_optimizer.minimize(loss, startup_program)
+        program = loss.block.program
+        with program_guard(program, startup_program or
+                           default_startup_program()):
+            helper = LayerHelper("lookahead")
+            block = program.global_block()
+            step = helper.create_global_variable(
+                [1], "float32", persistable=True,
+                name=unique_name.generate("la_step"),
+                initializer=Constant(0.0))
+            block.append_op("increment", inputs={"X": [step.name]},
+                            outputs={"Out": [step.name]}, attrs={"step": 1.0})
+            kconst = tensor.fill_constant([1], "float32", float(self.k))
+            mod = nn.elementwise_mod(block.var(step.name), kconst)
+            sync = tensor.cast(nn.elementwise_mul(
+                tensor.cast(mod < 0.5, "float32"),
+                tensor.cast(block.var(step.name) >= 0.5, "float32")),
+                "float32")
+            keep = nn.scale(sync, scale=-1.0, bias=1.0)
+            for p, g in pg:
+                if g is None:
+                    continue
+                slow = helper.create_global_variable(
+                    list(p.shape), "float32", persistable=True,
+                    name=unique_name.generate(p.name + "_slow"),
+                    initializer=Constant(0.0))
+                init_flag = helper.create_global_variable(
+                    [1], "float32", persistable=True,
+                    name=unique_name.generate(p.name + "_slow_init"),
+                    initializer=Constant(0.0))
+                # first update: slow <- p
+                fresh = nn.scale(block.var(init_flag.name), scale=-1.0,
+                                 bias=1.0)
+                slow_seeded = nn.elementwise_add(
+                    nn.elementwise_mul(block.var(slow.name),
+                                       block.var(init_flag.name)),
+                    nn.elementwise_mul(block.var(p.name), fresh))
+                block.append_op("fill_constant",
+                                outputs={"Out": [init_flag.name]},
+                                attrs={"shape": [1], "dtype": "float32",
+                                       "value": 1.0})
+                new_slow = nn.elementwise_add(
+                    slow_seeded,
+                    nn.elementwise_mul(
+                        nn.elementwise_sub(block.var(p.name), slow_seeded),
+                        nn.elementwise_mul(sync, tensor.fill_constant(
+                            [1], "float32", self.alpha))))
+                block.append_op("assign", inputs={"X": [new_slow]},
+                                outputs={"Out": [slow.name]})
+                new_fast = nn.elementwise_add(
+                    nn.elementwise_mul(new_slow, sync),
+                    nn.elementwise_mul(block.var(p.name), keep))
+                block.append_op("assign", inputs={"X": [new_fast]},
+                                outputs={"Out": [p.name]})
+        return ops, pg
 
 
 # Short aliases matching fluid.optimizer public names.

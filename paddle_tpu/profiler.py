@@ -1,0 +1,109 @@
+"""Profiler: JAX/XLA trace capture + host-side op aggregate table.
+
+Reference: platform/profiler.{h,cc} (RecordEvent push/pop, EnableProfiler states),
+platform/device_tracer.* (CUPTI kernel records), tools/timeline.py (Chrome trace).
+
+TPU-native mapping (SURVEY.md §5.1): device-side timing comes from the JAX/XLA
+profiler (xplane traces, viewable in TensorBoard/Perfetto -- the chrome://tracing
+analog); host-side RecordEvent annotations use jax.profiler.TraceAnnotation so they
+appear on the same timeline; and an aggregate per-label table mirrors the reference's
+printed op-time summary.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+
+class _Agg(threading.local):
+    def __init__(self):
+        self.times: Dict[str, list] = defaultdict(list)
+        self.enabled = False
+
+
+_agg = _Agg()
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RAII host annotation (reference RecordEvent, profiler.h:81)."""
+    import jax
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    if _agg.enabled:
+        _agg.times[name].append(time.perf_counter() - t0)
+
+
+class RecordEvent:
+    def __init__(self, name):
+        self.name = name
+        self._cm = None
+
+    def __enter__(self):
+        self._cm = record_event(self.name)
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
+    """Reference EnableProfiler. state kept for parity (CPU/GPU/All); the XLA
+    trace always captures both host and device."""
+    import jax
+    _agg.enabled = True
+    _agg.times.clear()
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+        _agg.trace_dir = trace_dir
+    else:
+        _agg.trace_dir = None
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+    """Reference DisableProfiler: stop + print the aggregate table."""
+    import jax
+    if getattr(_agg, "trace_dir", None):
+        jax.profiler.stop_trace()
+    _agg.enabled = False
+    table = summary(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(table)
+    print(table)
+    return table
+
+
+def summary(sorted_key: str = "total") -> str:
+    rows = []
+    for name, ts in _agg.times.items():
+        rows.append((name, len(ts), sum(ts), sum(ts) / len(ts), min(ts),
+                     max(ts)))
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key, 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"
+             f"{'Min(s)':>12}{'Max(s)':>12}"]
+    for r in rows:
+        lines.append(f"{r[0]:<40}{r[1]:>8}{r[2]:>12.6f}{r[3]:>12.6f}"
+                     f"{r[4]:>12.6f}{r[5]:>12.6f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None, trace_dir: Optional[str] = None):
+    """``with profiler.profiler():`` context (reference fluid/profiler.py)."""
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def reset_profiler():
+    _agg.times.clear()

@@ -1,0 +1,269 @@
+"""Input pipeline: DataLoader with background prefetch + reader decorators.
+
+Reference: python/paddle/fluid/reader.py (DataLoader.from_generator:73,
+GeneratorLoader:298, PyReader:569), operators/reader/buffered_reader.* (the
+double-buffer prefetch-to-device), python/paddle/reader/decorator.py.
+
+TPU-native: the C++ reader-op stack (create_py_reader_op / LoDTensorBlockingQueue)
+collapses into a host thread + queue that optionally stages the next batch on device
+(jax.device_put) while the current step runs -- same double-buffering, no graph ops.
+Per-host sharding for multi-host SPMD hooks in via ``shard(num_shards, shard_id)``.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .framework import Variable
+
+
+class DataLoader:
+    """Iterable feeder: yields feed dicts ready for Executor.run."""
+
+    def __init__(self, feed_list: Sequence[Variable], capacity: int = 4,
+                 return_list: bool = False, use_double_buffer: bool = True):
+        self.feed_list = list(feed_list)
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self._batch_fn: Optional[Callable[[], Iterable]] = None
+
+    # -- construction (reference reader.py:73) -----------------------------------------
+    @staticmethod
+    def from_generator(feed_list, capacity=4, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return DataLoader(feed_list, capacity, return_list, use_double_buffer)
+
+    def set_batch_generator(self, fn, places=None):
+        """fn() yields tuples/lists of arrays aligned with feed_list."""
+        self._batch_fn = fn
+        return self
+
+    def set_sample_list_generator(self, fn, places=None):
+        def batches():
+            for sample_list in fn():
+                cols = list(zip(*sample_list))
+                yield [np.asarray(c) for c in cols]
+        self._batch_fn = batches
+        return self
+
+    def set_sample_generator(self, fn, batch_size, drop_last=True, places=None):
+        def batches():
+            buf = []
+            for sample in fn():
+                buf.append(sample if isinstance(sample, (tuple, list))
+                           else (sample,))
+                if len(buf) == batch_size:
+                    yield [np.asarray(c) for c in zip(*buf)]
+                    buf = []
+            if buf and not drop_last:
+                yield [np.asarray(c) for c in zip(*buf)]
+        self._batch_fn = batches
+        return self
+
+    # -- iteration ---------------------------------------------------------------------
+    def _names(self):
+        return [v.name for v in self.feed_list]
+
+    def __iter__(self):
+        if self._batch_fn is None:
+            raise RuntimeError("DataLoader has no generator; call "
+                               "set_batch_generator/set_sample_generator first")
+        names = self._names()
+        q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
+        stop = object()
+        exc: List[BaseException] = []
+
+        def producer():
+            try:
+                for batch in self._batch_fn():
+                    vals = list(batch)
+                    if self.use_double_buffer:
+                        # stage on device while the consumer computes
+                        import jax
+                        vals = [jax.device_put(v) if isinstance(
+                            v, np.ndarray) else v for v in vals]
+                    q.put(dict(zip(names, vals)))
+            except BaseException as e:  # surface in consumer
+                exc.append(e)
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                if exc:
+                    raise exc[0]
+                return
+            yield item
+
+
+class PyReader(DataLoader):
+    """Legacy facade (reference reader.py:569)."""
+
+    def decorate_batch_generator(self, fn, places=None):
+        return self.set_batch_generator(fn, places)
+
+    def decorate_sample_list_generator(self, fn, places=None):
+        return self.set_sample_list_generator(fn, places)
+
+
+class DataFeeder:
+    """numpy conversion + batching of feed data (reference data_feeder.py)."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_list = [v if isinstance(v, Variable) else None
+                          for v in feed_list]
+        self.names = [v.name if isinstance(v, Variable) else str(v)
+                      for v in feed_list]
+
+    def feed(self, iterable):
+        cols = list(zip(*iterable))
+        out = {}
+        for name, col, var in zip(self.names, cols,
+                                  self.feed_list):
+            arr = np.asarray(col)
+            if var is not None and var.dtype and arr.dtype.kind == "f":
+                arr = arr.astype(var.dtype if var.dtype != "bfloat16"
+                                 else "float32")
+            out[name] = arr
+        return out
+
+
+# --------------------------------------------------------------------------------------
+# reader decorators (reference python/paddle/reader/decorator.py)
+# --------------------------------------------------------------------------------------
+
+def batch(reader, batch_size, drop_last=False):
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def shuffle(reader, buf_size, seed=None):
+    rng = _random.Random(seed)
+
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+    return shuffled
+
+
+def cache(reader):
+    all_data: List = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        yield from all_data
+    return cached
+
+
+def firstn(reader, n):
+    def first():
+        yield from itertools.islice(reader(), n)
+    return first
+
+
+def map_readers(func, *readers):
+    def mapped():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return mapped
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+    return chained
+
+
+def compose(*readers):
+    def composed():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return composed
+
+
+def buffered(reader, size):
+    def buf():
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+        stop = object()
+
+        def produce():
+            for item in reader():
+                q.put(item)
+            q.put(stop)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                return
+            yield item
+    return buf
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map via threads (the reference uses threads too)."""
+    def mapped():
+        items = list(reader())
+        results: List = [None] * len(items)
+        idx_q: "queue.Queue" = queue.Queue()
+        for i in range(len(items)):
+            idx_q.put(i)
+
+        def work():
+            while True:
+                try:
+                    i = idx_q.get_nowait()
+                except queue.Empty:
+                    return
+                results[i] = mapper(items[i])
+
+        threads = [threading.Thread(target=work) for _ in range(process_num)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        yield from results
+    return mapped
+
+
+def shard(reader, num_shards, shard_id):
+    """Per-host sharding for multi-host input pipelines (fleet analog)."""
+    def sharded():
+        for i, item in enumerate(reader()):
+            if i % num_shards == shard_id:
+                yield item
+    return sharded

@@ -1,0 +1,94 @@
+"""Flags / profiler / debugger tests (reference: test_profiler.py, gflags bridge)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _tiny():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_flags_env_and_set():
+    assert fluid.get_flag("check_nan_inf") is False
+    fluid.set_flags({"FLAGS_benchmark": True})
+    assert fluid.get_flag("benchmark") is True
+    fluid.set_flags({"FLAGS_benchmark": False})
+    # CUDA-era knobs accepted silently
+    fluid.set_flags({"FLAGS_fraction_of_gpu_memory_to_use": 0.5})
+    assert fluid.get_flag("fraction_of_gpu_memory_to_use") == 0.5
+
+
+def test_check_nan_inf_flag_catches_divergence():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(fluid.layers.exp(fluid.layers.scale(y, 100.0)))
+        fluid.optimizer.SGD(1e6).minimize(loss)
+    exe = fluid.Executor()
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            with pytest.raises(FloatingPointError, match="NaN/Inf"):
+                for _ in range(5):
+                    exe.run(main, feed={"x": np.full((4, 4), 50.0, "float32")},
+                            fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_dtype_flag():
+    fluid.set_flags({"FLAGS_check_dtype": True})
+    try:
+        main, startup, loss = _tiny()
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_check_dtype": False})
+
+
+def test_profiler_aggregate_table():
+    main, startup, loss = _tiny()
+    exe = fluid.Executor()
+    fluid.set_flags({"FLAGS_profile_executor": True})
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.profiler.start_profiler()
+            for _ in range(3):
+                exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=[loss])
+            table = fluid.profiler.stop_profiler()
+    finally:
+        fluid.set_flags({"FLAGS_profile_executor": False})
+    assert "executor_run" in table
+    assert "Calls" in table
+
+
+def test_record_event_nesting():
+    fluid.profiler.start_profiler()
+    with fluid.profiler.record_event("outer"):
+        with fluid.profiler.record_event("inner"):
+            pass
+    table = fluid.profiler.stop_profiler()
+    assert "outer" in table and "inner" in table
+
+
+def test_debugger_outputs():
+    main, startup, loss = _tiny()
+    dot = fluid.debugger.draw_graph(main)
+    assert dot.startswith("digraph") and "mul" in dot
+    summary = fluid.debugger.program_summary(main)
+    assert "params: 2" in summary
+    assert "sgd" in summary

@@ -1,0 +1,182 @@
+"""Checkpoint/save-load + DataLoader tests (analog of reference test_io_save_load,
+test_inference_model_io, test_py_reader_* and reader decorator tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build_and_train(tmp, steps=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [8], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 8).astype("float32"),
+            "label": rng.randint(0, 4, (8, 1)).astype("int64")}
+    exe = fluid.Executor()
+    exe.run(startup)
+    for _ in range(steps):
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+    return main, startup, loss, logits, feed, exe, float(lv[0])
+
+
+def test_save_load_persistables_resume(tmp_path):
+    d = str(tmp_path / "ckpt")
+    scope1 = fluid.Scope()
+    with fluid.scope_guard(scope1):
+        main, startup, loss, logits, feed, exe, loss_before = \
+            _build_and_train(d)
+        fluid.io.save_persistables(exe, d, main)
+        # continue training in scope1 for reference trajectory
+        ref, = exe.run(main, feed=feed, fetch_list=[loss])
+
+    # fresh scope: load and resume -> identical next-step loss
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.io.load_persistables(exe, d, main)
+        got, = exe.run(main, feed=feed, fetch_list=[loss])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_save_params_excludes_optimizer_state(tmp_path):
+    d = str(tmp_path / "params")
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, loss, logits, feed, exe, _ = _build_and_train(d)
+        fluid.io.save_params(exe, d, main)
+    import json
+    with open(os.path.join(d, "__manifest__.json")) as f:
+        names = {m["name"] for m in json.load(f)["vars"]}
+    assert any("w_0" in n for n in names)
+    assert not any("moment" in n for n in names)
+    assert not any("learning_rate" in n for n in names)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    d = str(tmp_path / "infer")
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, loss, logits, feed, exe, _ = _build_and_train(d)
+        fluid.io.save_inference_model(d, ["x"], [logits], exe, main)
+        # logits are computed from the saved params before the in-step update
+        ref, = exe.run(main, feed=feed, fetch_list=[logits])
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor()
+        prog, feed_names, fetch_names = fluid.io.load_inference_model(d, exe2)
+        assert feed_names == ["x"]
+        got, = exe2.run(prog, feed={"x": feed["x"]}, fetch_list=fetch_names)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # pruned program must not contain backward/optimizer ops
+    types = [op.type for op in prog.global_block().ops]
+    assert not any(t.endswith("_grad") or t == "adam" for t in types)
+
+
+def test_load_shape_mismatch_errors(tmp_path):
+    d = str(tmp_path / "bad")
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, loss, logits, feed, exe, _ = _build_and_train(d)
+        fluid.io.save_params(exe, d, main)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main2, startup2):
+        x = fluid.data("x", [8], "float32")
+        fluid.layers.fc(x, 32)  # different width
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError, match="shape mismatch|no variable"):
+            fluid.io.load_params(fluid.Executor(), d, main2)
+
+
+def test_dataloader_prefetch_and_order():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.data("y", [1], "int64")
+    loader = fluid.DataLoader.from_generator([x, y], capacity=2)
+
+    def gen():
+        for i in range(10):
+            yield (np.full((2, 4), i, "float32"),
+                   np.full((2, 1), i, "int64"))
+
+    loader.set_batch_generator(gen)
+    seen = [int(np.asarray(b["x"])[0, 0]) for b in loader]
+    assert seen == list(range(10))
+
+
+def test_dataloader_propagates_generator_errors():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("xx", [4], "float32")
+    loader = fluid.DataLoader.from_generator([x])
+
+    def bad():
+        yield (np.zeros((2, 4), "float32"),)
+        raise RuntimeError("boom in generator")
+
+    loader.set_batch_generator(bad)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(10))
+    b = fluid.reader.batch(r, 3)
+    assert list(b()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    b2 = fluid.reader.batch(r, 3, drop_last=True)
+    assert list(b2()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    s = fluid.reader.shuffle(r, 5, seed=0)
+    out = list(s())
+    assert sorted(out) == list(range(10)) and out != list(range(10))
+    assert list(fluid.reader.firstn(r, 3)()) == [0, 1, 2]
+    m = fluid.reader.map_readers(lambda a, b: a + b, r, r)
+    assert list(m()) == [2 * i for i in range(10)]
+    sh = fluid.reader.shard(r, 4, 1)
+    assert list(sh()) == [1, 5, 9]
+
+
+def test_train_with_dataloader_end_to_end():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [16], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(x, 4), label))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    loader = fluid.DataLoader.from_generator([x, label], capacity=4)
+    rng = np.random.RandomState(0)
+    W = rng.randn(16, 4).astype("float32")
+
+    def gen():
+        for _ in range(20):
+            xb = rng.randn(32, 16).astype("float32")
+            yield xb, np.argmax(xb @ W, 1)[:, None].astype("int64")
+
+    loader.set_batch_generator(gen)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for feed in loader:
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(lv[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_metrics_accumulators():
+    acc = fluid.metrics.Accuracy()
+    acc.update(0.5, 10)
+    acc.update(1.0, 10)
+    assert abs(acc.eval() - 0.75) < 1e-9
+    auc = fluid.metrics.Auc()
+    preds = np.array([[0.9, 0.1], [0.2, 0.8], [0.4, 0.6], [0.7, 0.3]])
+    labels = np.array([0, 1, 1, 0])
+    auc.update(preds, labels)
+    assert auc.eval() == 1.0

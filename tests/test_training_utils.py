@@ -1,0 +1,181 @@
+"""AMP / recompute / EMA / ModelAverage / Lookahead tests
+(analog of reference test_fp16_utils / test_recompute_optimizer / test_ema /
+test_lookahead)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import mixed_precision as amp
+
+
+def _net(hidden=32):
+    x = fluid.data("x", [16], "float32")
+    label = fluid.data("label", [1], "int64")
+    h1 = fluid.layers.fc(x, hidden, act="relu")
+    h2 = fluid.layers.fc(h1, hidden, act="relu")
+    logits = fluid.layers.fc(h2, 4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    return x, label, h1, h2, loss
+
+
+def _feeds(rng, B=16):
+    x = rng.randn(B, 16).astype("float32")
+    W = rng.randn(16, 4).astype("float32")
+    return {"x": x, "label": np.argmax(x @ W, 1)[:, None].astype("int64")}
+
+
+def test_amp_bf16_rewrite_and_training():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, label, h1, h2, loss = _net()
+        opt = amp.decorate(fluid.optimizer.Adam(0.01))
+        opt.minimize(loss)
+    # rewrite inserted cast ops and mul runs in bf16
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    mul_ops = [op for op in main.global_block().ops if op.type == "mul"]
+    assert any(main.global_block().var(op.input("X")[0]).dtype == "bfloat16"
+               for op in mul_ops)
+    rng = np.random.RandomState(0)
+    feeds = _feeds(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            lv, = exe.run(main, feed=feeds, fetch_list=[loss])
+            losses.append(float(lv[0]))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_amp_dynamic_loss_scaling_fp16_style():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, label, h1, h2, loss = _net()
+        opt = amp.decorate(fluid.optimizer.SGD(0.1), init_loss_scaling=8.0,
+                           use_dynamic_loss_scaling=True, incr_every_n_steps=2,
+                           dest_dtype="bfloat16")
+        opt.minimize(loss)
+        scale_var = opt.get_loss_scaling()
+    rng = np.random.RandomState(0)
+    feeds = _feeds(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        scales = []
+        for _ in range(5):
+            sv, lv = exe.run(main, feed=feeds, fetch_list=[scale_var, loss])
+            scales.append(float(sv[0]))
+        assert np.isfinite(lv).all()
+    # finite steps -> scale grows every incr_every_n steps
+    assert scales[-1] > 8.0, scales
+
+
+def test_recompute_matches_plain_backward():
+    def build(use_recompute):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 7
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x, label, h1, h2, loss = _net()
+            sgd = fluid.optimizer.SGD(0.1)
+            if use_recompute:
+                opt = fluid.optimizer.RecomputeOptimizer(sgd)
+                opt._set_checkpoints([h1, h2])
+                opt.minimize(loss)
+            else:
+                sgd.minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    feeds = _feeds(rng)
+
+    losses = {}
+    for flag in (False, True):
+        main, startup, loss = build(flag)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            tr = []
+            for _ in range(5):
+                lv, = exe.run(main, feed=feeds, fetch_list=[loss])
+                tr.append(float(lv[0]))
+        losses[flag] = tr
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-4,
+                               atol=1e-6)
+    # and the rewritten program actually contains remat segments
+    main, _, _ = build(True)
+    assert any(op.type == "remat_segment"
+               for op in main.global_block().ops)
+
+
+def test_ema_apply_restore():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, label, h1, h2, loss = _net(hidden=8)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+    rng = np.random.RandomState(0)
+    feeds = _feeds(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        sc = fluid.global_scope()
+        exe.run(startup)
+        for _ in range(5):
+            exe.run(main, feed=feeds, fetch_list=[loss])
+        pname = [p.name for p in main.all_parameters()][0]
+        raw = np.asarray(sc.find_var(pname)).copy()
+        with ema.apply():
+            applied = np.asarray(sc.find_var(pname)).copy()
+            assert not np.allclose(raw, applied)
+        restored = np.asarray(sc.find_var(pname))
+        np.testing.assert_allclose(raw, restored)
+
+
+def test_model_average_apply():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, label, h1, h2, loss = _net(hidden=8)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+        ma = fluid.optimizer.ModelAverage()
+        ma.update()
+    rng = np.random.RandomState(0)
+    feeds = _feeds(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        sc = fluid.global_scope()
+        exe.run(startup)
+        for _ in range(4):
+            exe.run(main, feed=feeds, fetch_list=[loss])
+        pname = [p.name for p in main.all_parameters()][0]
+        raw = np.asarray(sc.find_var(pname)).copy()
+        with ma.apply():
+            avg = np.asarray(sc.find_var(pname)).copy()
+            assert not np.allclose(raw, avg)
+        np.testing.assert_allclose(raw, np.asarray(sc.find_var(pname)))
+
+
+def test_lookahead_syncs_every_k():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, label, h1, h2, loss = _net(hidden=8)
+        opt = fluid.optimizer.LookaheadOptimizer(
+            fluid.optimizer.SGD(0.2), alpha=0.5, k=3)
+        opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    feeds = _feeds(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(9):
+            lv, = exe.run(main, feed=feeds, fetch_list=[loss])
+            losses.append(float(lv[0]))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
