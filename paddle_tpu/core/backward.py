@@ -126,7 +126,8 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
 
     Reference: backward.py:933. The loss gradient is seeded with ones; the
     ScaleLossGradOpHandle 1/num_devices scaling is NOT applied here -- under SPMD the
-    data-parallel mean is taken by the gradient reduction rewrite (parallel/spmd.py).
+    data-parallel mean falls out of GSPMD's reduction of the batch-sharded loss
+    (compiler.py DistributedStrategy).
     """
     block = loss.block.program.global_block()
     no_grad = _collect_no_grad(block, no_grad_set)

@@ -189,6 +189,21 @@ class Executor:
                        for v in (fetch_list or [])]
         scope = scope or global_scope()
 
+        if use_prune and fetch_names:
+            # Fetch-graph pruning (reference executor.py _prune_program): run only
+            # the ops needed to produce the fetches — eval-style fetches must not
+            # trigger optimizer updates.
+            pkey = (id(program), program._version, tuple(fetch_names))
+            if not hasattr(self, "_prune_cache"):
+                self._prune_cache = {}
+            pruned = self._prune_cache.get(pkey)
+            if pruned is None:
+                pruned = program._prune(list(feed), fetch_names)
+                self._prune_cache[pkey] = pruned
+                while len(self._prune_cache) > self._CACHE_CAP:
+                    self._prune_cache.pop(next(iter(self._prune_cache)))
+            program = pruned
+
         if compiled_wrapper is not None and compiled_wrapper.dist_strategy:
             ds = compiled_wrapper.dist_strategy
             compiled_wrapper.mesh  # force mesh build (fills default mesh_shape)

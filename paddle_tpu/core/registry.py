@@ -218,7 +218,15 @@ def _generic_grad_lower(fwd: OpDef, ctx, ins):
             g = provided[i] if provided is not None and i < len(provided) else None
             cot[s][i] = (jnp.asarray(g, o.dtype) if g is not None
                          else jnp.zeros(o.shape, o.dtype))
-    grads = vjp(cot)
+    try:
+        grads = vjp(cot)
+    except ValueError as e:
+        if "while_loop" in str(e):
+            raise ValueError(
+                "gradient through a dynamic `while` needs a static bound: set "
+                "attr max_iters=N on the while op so it lowers to a "
+                f"differentiable masked scan ({e})") from e
+        raise
 
     result: Dict[str, List] = {}
     for s in fwd_in_slots:

@@ -141,11 +141,16 @@ def to_variable(value, name=None, zero_copy=None) -> VarBase:
     return VarBase(value, name=name)
 
 
-def _ctx(attrs) -> LowerCtx:
+def _ctx(attrs, salt=None) -> LowerCtx:
+    """Build a LowerCtx; ``salt`` replays a recorded forward PRNG salt so grad
+    lowerings of stochastic ops (dropout) see the same mask as forward —
+    the dygraph analog of the static executor's __fwd_out0__ mechanism."""
     import jax
-    _state.op_counter += 1
+    if salt is None:
+        _state.op_counter += 1
+        salt = _state.op_counter
     key = jax.random.PRNGKey(_state.seed)
-    return LowerCtx(attrs, key, _state.op_counter)
+    return LowerCtx(attrs, key, salt)
 
 
 def trace_op(op_type: str, ins: Dict[str, List[VarBase]], attrs: dict,
@@ -154,7 +159,8 @@ def trace_op(op_type: str, ins: Dict[str, List[VarBase]], attrs: dict,
     d = registry.get(op_type)
     raw_ins = {s: [v.value if v is not None else None for v in vs]
                for s, vs in ins.items()}
-    outs = d.lower(_ctx(attrs), raw_ins)
+    ctx = _ctx(attrs)
+    outs = d.lower(ctx, raw_ins)
     out_vars: Dict[str, List[VarBase]] = {}
     stop_all = all(v is None or v.stop_gradient
                    for vs in ins.values() for v in vs)
@@ -164,6 +170,7 @@ def trace_op(op_type: str, ins: Dict[str, List[VarBase]], attrs: dict,
                        if v is not None else None for v in vals]
     if _state.taping and not stop_all and d.grad is not None:
         _state.tape.append({"type": op_type, "attrs": dict(attrs),
+                            "salt": ctx._salt,
                             "ins": {s: list(vs) for s, vs in ins.items()},
                             "outs": {s: list(vs)
                                      for s, vs in out_vars.items()}})
@@ -193,7 +200,7 @@ def backward(loss: VarBase):
         d = registry.get(entry["type"] + "_grad")
         attrs = dict(entry["attrs"])
         attrs["__fwd_out_slots__"] = sorted(entry["outs"])
-        result = d.lower(_ctx(attrs), grad_ins)
+        result = d.lower(_ctx(attrs, salt=entry["salt"]), grad_ins)
         for s, vs in entry["ins"].items():
             gvals = result.get(s + "@GRAD")
             if gvals is None:

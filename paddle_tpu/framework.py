@@ -466,6 +466,56 @@ class Program:
                         op.attrs["is_test"] = True
         return p
 
+    def _prune(self, feed_names, target_names, for_test: bool = False) -> "Program":
+        """Slice to the subgraph producing ``target_names`` from ``feed_names``
+        (reference framework/prune.cc; used by save_inference_model and
+        Executor.run(use_prune=True))."""
+        pruned = self.clone(for_test=for_test)
+        block = pruned.global_block()
+
+        def op_reads(op):
+            """Input names of ``op`` plus outer-var reads of any sub-block it
+            references (while/scan/cond bodies see the enclosing env)."""
+            reads = list(op.input_arg_names())
+            sub_idx = op.attrs.get("sub_block")
+            stack = [sub_idx] if isinstance(sub_idx, int) else []
+            eb = op.attrs.get("else_block")
+            if isinstance(eb, int) and eb >= 0:
+                stack.append(eb)
+            seen = set()
+            while stack:
+                bi = stack.pop()
+                if bi in seen or bi >= len(pruned.blocks):
+                    continue
+                seen.add(bi)
+                produced = set()
+                for sop in pruned.blocks[bi].ops:
+                    for n in sop.input_arg_names():
+                        if n not in produced:
+                            reads.append(n)
+                    produced.update(sop.output_arg_names())
+                    si = sop.attrs.get("sub_block")
+                    if isinstance(si, int):
+                        stack.append(si)
+            return reads
+
+        needed = set(target_names)
+        keep = []
+        for i in range(len(block.ops) - 1, -1, -1):
+            op = block.ops[i]
+            if any(n in needed for n in op.output_arg_names()):
+                keep.append(i)
+                needed.update(op_reads(op))
+        keep = set(keep)
+        block.ops = [op for i, op in enumerate(block.ops) if i in keep]
+        referenced = set(feed_names) | set(target_names)
+        for op in block.ops:
+            referenced.update(op.input_arg_names())
+            referenced.update(op.output_arg_names())
+        block.vars = {n: v for n, v in block.vars.items() if n in referenced}
+        pruned._bump()
+        return pruned
+
     def list_vars(self):
         for b in self.blocks:
             yield from b.vars.values()

@@ -131,25 +131,7 @@ def _prune(program: Program, feed_names: Sequence[str],
            target_names: Sequence[str]) -> Program:
     """Slice the program to the subgraph producing targets from feeds
     (reference framework/prune.cc)."""
-    pruned = program.clone(for_test=True)
-    block = pruned.global_block()
-    needed = set(target_names)
-    keep = []
-    for i in range(len(block.ops) - 1, -1, -1):
-        op = block.ops[i]
-        if any(n in needed for n in op.output_arg_names()):
-            keep.append(i)
-            needed.update(op.input_arg_names())
-    keep = set(keep)
-    block.ops = [op for i, op in enumerate(block.ops) if i in keep]
-    # drop vars not referenced anymore
-    referenced = set(feed_names) | set(target_names)
-    for op in block.ops:
-        referenced.update(op.input_arg_names())
-        referenced.update(op.output_arg_names())
-    block.vars = {n: v for n, v in block.vars.items() if n in referenced}
-    pruned._bump()
-    return pruned
+    return program._prune(feed_names, target_names, for_test=True)
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,

@@ -167,21 +167,41 @@ def shape_op(ctx, ins):
 
 @register("range", grad=None)
 def range_op(ctx, ins):
+    """Static-shape arange: start/end/step come from attrs (preferred) or from
+    concrete (host-side) input tensors. Traced inputs cannot drive the output
+    shape under jit -- range is a build-time op."""
     jnp = _jnp()
-    start = float(np.asarray(ins["Start"][0]))
-    end = float(np.asarray(ins["End"][0]))
-    step = float(np.asarray(ins["Step"][0]))
-    # NOTE: requires concrete (host) start/end/step -- range is a build-time op.
-    return {"Out": [jnp.arange(start, end, step,
-                               dtype=ins["Start"][0].dtype)]}
+    if ctx.attr("start") is not None:
+        start, end = ctx.attr("start"), ctx.attr("end")
+        step = ctx.attr("step", 1)
+        dtype = _np_dtype(ctx.attr("dtype", "int64"))
+    else:
+        try:
+            start = float(np.asarray(ins["Start"][0]))
+            end = float(np.asarray(ins["End"][0]))
+            step = float(np.asarray(ins["Step"][0]))
+        except Exception as e:
+            raise ValueError(
+                "range needs static bounds: pass attrs start/end/step (traced "
+                f"tensor inputs cannot set the output shape): {e}") from e
+        dtype = ins["Start"][0].dtype
+    return {"Out": [jnp.arange(start, end, step, dtype=dtype)]}
 
 
 @register("linspace", grad=None)
 def linspace(ctx, ins):
     jnp = _jnp()
-    return {"Out": [jnp.linspace(float(np.asarray(ins["Start"][0])),
-                                 float(np.asarray(ins["Stop"][0])),
-                                 int(np.asarray(ins["Num"][0])))]}
+    if ctx.attr("num") is not None:
+        return {"Out": [jnp.linspace(ctx.attr("start"), ctx.attr("stop"),
+                                     int(ctx.attr("num")))]}
+    try:
+        return {"Out": [jnp.linspace(float(np.asarray(ins["Start"][0])),
+                                     float(np.asarray(ins["Stop"][0])),
+                                     int(np.asarray(ins["Num"][0])))]}
+    except Exception as e:
+        raise ValueError(
+            "linspace needs static bounds: pass attrs start/stop/num (traced "
+            f"tensor inputs cannot set the output shape): {e}") from e
 
 
 @register("one_hot", grad=None, nondiff_inputs=("X",))

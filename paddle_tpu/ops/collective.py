@@ -49,7 +49,16 @@ def _lax():
 _coll("c_allreduce_sum", lambda x, n: _lax().psum(x, n))
 _coll("c_allreduce_max", lambda x, n: _lax().pmax(x, n))
 _coll("c_allreduce_min", lambda x, n: _lax().pmin(x, n))
-_coll("c_allreduce_prod", lambda x, n: _lax().psum(x, n))  # prod via log-sum not exact; see note
+def _pprod(x, name):
+    # Exact cross-device product: all_gather then reduce on the gathered axis.
+    # (XLA has no product all-reduce primitive; gather+prod keeps bit-exactness
+    # vs the sign/log trick, and these tensors are small in practice.)
+    import jax
+    import jax.numpy as jnp
+    return jnp.prod(jax.lax.all_gather(x, name), axis=0)
+
+
+_coll("c_allreduce_prod", _pprod)
 _coll("c_allreduce_avg", lambda x, n: _lax().pmean(x, n))
 
 

@@ -11,20 +11,42 @@ from __future__ import annotations
 from ..core.registry import register
 
 
-@register("while", grad=None)
+@register("while")
 def while_op(ctx, ins):
-    """attrs: sub_block (int), loop_vars (list of names carried), cond (name).
+    """attrs: sub_block (int), cond_name, x_names, out_names, and optionally
+    ``max_iters`` (static iteration bound).
 
-    The sub-block must rewrite the condition var and the loop vars each iteration.
+    The sub-block must rewrite the condition var and the loop vars each
+    iteration. Two lowerings (reference controlflow/while_op.cc + its grad op):
+
+    * ``max_iters`` set -> a masked ``lax.scan`` of exactly max_iters steps:
+      inactive steps keep the old carry via jnp.where. This is
+      reverse-mode differentiable (the generic vjp works through scan), the
+      TPU answer to the reference's StepScope-stack while-grad.
+    * no ``max_iters`` -> ``lax.while_loop``: data-dependent trip count, but
+      XLA forbids reverse-mode AD through it; requesting a gradient raises at
+      vjp-transpose time (registry._generic_grad_lower adds the max_iters
+      hint there).
     """
     import jax
+    import jax.numpy as jnp
 
     sub_idx = ctx.attr("sub_block")
-    carried = list(ctx.attr("loop_vars", []))
     cond_name = ctx.attr("cond_name")
     xs = ins["X"]
     x_names = ctx.attr("x_names", [])
     env0 = dict(zip(x_names, xs))
+    max_iters = ctx.attr("max_iters", None)
+
+    if max_iters is not None:
+        def body(env, _):
+            active = env[cond_name].reshape(()).astype(bool)
+            new_env = ctx.block_runner(sub_idx, dict(env))
+            merged = {k: jnp.where(active, new_env[k], env[k]) for k in env}
+            return merged, None
+
+        env_final, _ = jax.lax.scan(body, env0, None, length=int(max_iters))
+        return {"Out": [env_final[n] for n in ctx.attr("out_names", [])]}
 
     def cond_fn(env):
         return env[cond_name].reshape(())

@@ -55,6 +55,9 @@ def cumsum(ctx, ins):
     if ctx.attr("flatten", False):
         x = x.reshape(-1)
         axis = 0
+    reverse = ctx.attr("reverse", False)
+    if reverse:
+        x = jnp.flip(x, axis=axis)
     out = jnp.cumsum(x, axis=axis)
     if ctx.attr("exclusive", False):
         pad = [(0, 0)] * x.ndim
@@ -62,6 +65,6 @@ def cumsum(ctx, ins):
         sl = [slice(None)] * x.ndim
         sl[axis % x.ndim] = slice(0, x.shape[axis % x.ndim])
         out = jnp.pad(out, pad)[tuple(sl)]
-    if ctx.attr("reverse", False):
-        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis=axis), axis=axis), axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis=axis)
     return {"Out": [out]}

@@ -7,6 +7,8 @@ Each op takes 'Length' (int lengths) where the reference consumed LoD.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ..core.registry import register
 
 
@@ -81,9 +83,27 @@ def sequence_softmax(ctx, ins):
 
 @register("sequence_expand", nondiff_inputs=("Length",))
 def sequence_expand(ctx, ins):
+    """Repeat row i of X ``ref_lengths[i]`` times (reference
+    sequence_ops/sequence_expand_op.cc, LoD-driven row expansion).
+
+    XLA needs a static output row count, so the expansion counts must be given
+    statically: either attr ``ref_lengths`` (list of ints, one per row) or attr
+    ``expand_times`` (uniform repeat). A runtime Length tensor alone cannot
+    drive a dynamic output shape under jit -- fail loudly rather than return X.
+    """
     jnp = _jnp()
     x = ins["X"][0]
-    return {"Out": [x]}
+    ref = ctx.attr("ref_lengths", None)
+    times = ctx.attr("expand_times", None)
+    if ref is not None:
+        idx = jnp.asarray(np.repeat(np.arange(len(ref)), ref).astype("int32"))
+        return {"Out": [jnp.take(x, idx, axis=0)]}
+    if times is not None:
+        return {"Out": [jnp.repeat(x, int(times), axis=0)]}
+    raise NotImplementedError(
+        "sequence_expand needs static expansion counts on TPU: pass attr "
+        "'ref_lengths' (per-row repeat counts) or 'expand_times' (uniform); "
+        "dynamic LoD-driven output shapes cannot be compiled.")
 
 
 @register("sequence_reverse", nondiff_inputs=("Length",))

@@ -493,12 +493,23 @@ class ExponentialMovingAverage:
         self._decay = decay
         self._shadow = {}
         self._backup = {}
+        self._decay_pow_name = None
 
     def update(self):
         from .framework import default_main_program
         from .initializer import Constant
         block = default_main_program().global_block()
         helper = LayerHelper("ema")
+        # decay^t accumulator for zero-debias in apply() (the reference divides
+        # by (1 - decay^t), optimizer.py:2449 region).
+        dp = helper.create_global_variable(
+            [1], "float32", persistable=True,
+            name=unique_name.generate("ema_decay_pow"),
+            initializer=Constant(1.0))
+        self._decay_pow_name = dp.name
+        block.append_op("scale", inputs={"X": [dp.name]},
+                        outputs={"Out": [dp.name]},
+                        attrs={"scale": self._decay})
         for p in block.all_parameters():
             if not p.trainable:
                 continue
@@ -521,13 +532,22 @@ class ExponentialMovingAverage:
                             outputs={"Out": [shadow.name]})
 
     def apply(self, executor=None, need_restore=True):
+        import numpy as np
         from .core.executor import global_scope
         scope = global_scope()
+        debias = 1.0
+        if self._decay_pow_name is not None:
+            pow_val = scope.find_var(self._decay_pow_name)
+            if pow_val is not None:
+                pw = float(np.asarray(pow_val).reshape(-1)[0])
+                if pw < 1.0:
+                    debias = 1.0 - pw  # shadow seeded at 0 => divide by 1-decay^t
         for pname, sname in self._shadow.items():
             self._backup[pname] = scope.find_var(pname)
             val = scope.find_var(sname)
             if val is not None:
-                scope.set_var(pname, val)
+                arr = np.asarray(val, dtype="float32") / debias
+                scope.set_var(pname, arr.astype(np.asarray(val).dtype))
         ema = self
 
         class _Guard:

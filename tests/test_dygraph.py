@@ -134,3 +134,21 @@ def test_no_grad_blocks_taping():
         loss = dygraph.trace_op("mean", {"X": [z]}, {}, ["Out"])["Out"][0]
         loss.backward()
         assert x.gradient() is not None
+
+
+def test_dygraph_dropout_grad_uses_forward_mask():
+    """Regression (ADVICE r1): backward must replay the forward PRNG salt so
+    dropout's grad mask matches the forward mask exactly."""
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones((64,), "float32"))
+        x.stop_gradient = False
+        out = dygraph.trace_op(
+            "dropout", {"X": [x]},
+            {"dropout_prob": 0.5, "is_test": False,
+             "dropout_implementation": "upscale_in_train"}, ["Out"])["Out"][0]
+        loss = dygraph.trace_op("reduce_sum", {"X": [out]}, {}, ["Out"])["Out"][0]
+        fwd = out.numpy()
+        loss.backward()
+        g = x.gradient()
+        # grad nonzero exactly where forward kept the element
+        np.testing.assert_array_equal(g != 0.0, fwd != 0.0)

@@ -57,23 +57,37 @@ def test_dp8_loss_parity():
 
 
 def test_dp_params_stay_synchronized():
-    """Replicated params sharded over the mesh must be identical after updates."""
+    """Replicated params must hold identical values on every device after
+    updates, and match the single-device run bit-for-bit-ish."""
     import jax
+
+    def run(program_for_run, startup, loss):
+        rng = np.random.RandomState(1)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            sc = fluid.global_scope()
+            exe.run(startup)
+            for _ in range(3):
+                x = rng.randn(16, 32).astype("float32")
+                y = rng.randint(0, 10, (16, 1)).astype("int64")
+                exe.run(program_for_run, feed={"x": x, "label": y},
+                        fetch_list=[loss])
+            return sc.find_var("fc_0.w_0")
+
     main, startup, loss = _mlp_program(seed=5)
-    cp = fluid.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
-    rng = np.random.RandomState(1)
-    exe = fluid.Executor()
-    with fluid.scope_guard(fluid.Scope()):
-        sc = fluid.global_scope()
-        exe.run(startup)
-        for _ in range(3):
-            x = rng.randn(16, 32).astype("float32")
-            y = rng.randint(0, 10, (16, 1)).astype("int64")
-            exe.run(cp, feed={"x": x, "label": y}, fetch_list=[loss])
-        w = sc.find_var("fc_0.w_0")
-        # fully-replicated output sharding -> value is well-defined; check finite
-        wv = np.asarray(w)
-        assert np.isfinite(wv).all()
+    w_single = np.asarray(run(main, startup, loss))
+
+    main2, startup2, loss2 = _mlp_program(seed=5)
+    cp = fluid.CompiledProgram(main2).with_data_parallel(loss_name=loss2.name)
+    w_par = run(cp, startup2, loss2)
+
+    # every device shard of the replicated param must be identical
+    shards = [np.asarray(s.data) for s in w_par.addressable_shards]
+    assert len(shards) == len(jax.devices())
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+    # and the parallel result must match the single-device run
+    np.testing.assert_allclose(w_single, shards[0], rtol=2e-4, atol=1e-5)
 
 
 def test_tensor_parallel_fc():
