@@ -48,11 +48,12 @@ class ExecutionStrategy:
 
 
 class BuildStrategy:
-    """Reference details/build_strategy.h:38. All knobs are currently accepted
-    no-ops for port compatibility: the fusion/memory knobs are subsumed by XLA
-    (fusion and buffer reuse are always on), and reduce_strategy=Reduce (ZeRO-like
-    optimizer-state sharding over dp) is not implemented yet -- express parameter
-    sharding through DistributedStrategy.param_rules instead."""
+    """Reference details/build_strategy.h:38. The fusion/memory knobs are
+    subsumed by XLA (fusion and buffer reuse are always on; changing them
+    warns once). ``reduce_strategy=Reduce`` is real: optimizer-state
+    accumulators that would be replicated are ZeRO-sharded over the "dp" mesh
+    axis instead (the sharding analog of the reference's per-device param
+    ownership, details/reduce_op_handle.*)."""
 
     class ReduceStrategy:
         AllReduce = 0   # replicated params (default)
@@ -208,7 +209,7 @@ class CompiledProgram:
         return (tuple(sorted(ds.mesh_shape.items())),
                 tuple((p, tuple(s)) for p, s in ds.param_rules),
                 tuple((p, tuple(s)) for p, s in ds.data_rules),
-                ds.data_axis)
+                ds.data_axis, self.build_strategy.reduce_strategy)
 
     @property
     def mesh(self):

@@ -102,11 +102,15 @@ def _as_device_array(x, dtype=None):
 
 
 class _CompiledStep:
-    def __init__(self, fn, state_in_names, state_out_names, fetch_names):
+    def __init__(self, fn, state_in_names, state_out_names, fetch_names,
+                 state_shardings=None, feed_shardings=None):
         self.fn = fn
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
         self.fetch_names = fetch_names
+        # multi-host runs need the target shardings to assemble global arrays
+        self.state_shardings = state_shardings or {}
+        self.feed_shardings = feed_shardings or {}
 
 
 def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
@@ -207,20 +211,23 @@ class Executor:
         if compiled_wrapper is not None and compiled_wrapper.dist_strategy:
             ds = compiled_wrapper.dist_strategy
             compiled_wrapper.mesh  # force mesh build (fills default mesh_shape)
-            for k, v in feed.items():
-                shape = np.shape(v)
-                spec = ds.data_spec(k, len(shape))
-                for dim, axes in enumerate(spec):
-                    if axes is None or dim >= len(shape):
-                        continue
-                    n = 1
-                    for ax in (axes if isinstance(axes, tuple) else (axes,)):
-                        n *= ds.mesh_shape.get(ax, 1)
-                    if n > 1 and shape[dim] % n != 0:
-                        raise ValueError(
-                            f"feed {k!r} dim {dim} (={shape[dim]}) is not "
-                            f"divisible by mesh axes {axes!r} ({n} shards); "
-                            f"pad or drop the remainder batch")
+            # (multi-host: each process feeds only its local slice, so the
+            #  global divisibility check does not apply to the local shape)
+            if jax.process_count() == 1:
+                for k, v in feed.items():
+                    shape = np.shape(v)
+                    spec = ds.data_spec(k, len(shape))
+                    for dim, axes in enumerate(spec):
+                        if axes is None or dim >= len(shape):
+                            continue
+                        n = 1
+                        for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                            n *= ds.mesh_shape.get(ax, 1)
+                        if n > 1 and shape[dim] % n != 0:
+                            raise ValueError(
+                                f"feed {k!r} dim {dim} (={shape[dim]}) is not "
+                                f"divisible by mesh axes {axes!r} ({n} shards); "
+                                f"pad or drop the remainder batch")
         state_in, state_out = self._state_names(program, feed, fetch_names)
         missing = [n for n in state_in if not scope.has_var(n) or
                    scope.find_var(n) is None]
@@ -249,7 +256,27 @@ class Executor:
         mut_names, ro_names = compiled.state_in_names
         mut_vals = {n: scope.find_var(n) for n in mut_names}
         ro_vals = {n: scope.find_var(n) for n in ro_names}
-        feed_vals = {k: _as_device_array(v) for k, v in feed.items()}
+        if jax.process_count() > 1 and compiled.state_shardings:
+            # Multi-host SPMD: assemble global arrays. State values are
+            # host-identical full copies (deterministic startup) -> device_put
+            # against the target sharding; feeds are per-host slices of the
+            # global batch -> make_array_from_process_local_data (the per-host
+            # feed split of reference executor.py:618).
+            def to_global(v, sh):
+                if hasattr(v, "sharding") and v.sharding == sh:
+                    return v
+                return jax.device_put(np.asarray(v), sh)
+
+            mut_vals = {n: to_global(v, compiled.state_shardings[n])
+                        for n, v in mut_vals.items()}
+            ro_vals = {n: to_global(v, compiled.state_shardings[n])
+                       for n, v in ro_vals.items()}
+            feed_vals = {
+                k: jax.make_array_from_process_local_data(
+                    compiled.feed_shardings[k], np.asarray(v))
+                for k, v in feed.items()}
+        else:
+            feed_vals = {k: _as_device_array(v) for k, v in feed.items()}
         # The PRNG key for run k of a program is fold_in(PRNGKey(seed), k); the
         # counter lives on the Program so results are deterministic per program
         # regardless of what else ran (matters for seeded init).
@@ -350,15 +377,37 @@ class Executor:
             # with sharding constraints on state and feeds; XLA/GSPMD inserts the
             # ICI collectives the reference implemented as AllReduceOpHandles.
             from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..framework import Parameter
             ds = wrapper.dist_strategy
             mesh = wrapper.mesh
             var_of = block.find_var_recursive
+
+            # ReduceStrategy.Reduce (reference details/build_strategy.h:58,
+            # reduce_op_handle.*): the reference shards *ownership* of each
+            # param's optimizer update across devices. The TPU analog is
+            # ZeRO-style optimizer-state sharding: accumulators (moments etc.)
+            # that would be replicated get partitioned over "dp" instead --
+            # GSPMD gathers them where the update op needs them.
+            bs = getattr(wrapper, "build_strategy", None)
+            reduce_mode = (bs is not None and
+                           bs.reduce_strategy == type(bs).ReduceStrategy.Reduce
+                           and "dp" in mesh.shape and mesh.shape["dp"] > 1)
+
+            def zero_spec(shape):
+                ndp = mesh.shape["dp"]
+                for dim, s in enumerate(shape):
+                    if isinstance(s, int) and s > 0 and s % ndp == 0:
+                        return P(*([None] * dim), "dp")
+                return P()
 
             def state_sharding(names):
                 out = {}
                 for n in names:
                     v = var_of(n)
                     spec = ds.param_spec(n) if v is not None else P()
+                    if (reduce_mode and v is not None and spec == P()
+                            and not isinstance(v, Parameter)):
+                        spec = zero_spec(v.shape)
                     out[n] = NamedSharding(mesh, spec)
                 return out
 
@@ -378,8 +427,12 @@ class Executor:
             jitted = jax.jit(step, donate_argnums=(0,),
                              in_shardings=in_shardings,
                              out_shardings=out_shardings)
-        else:
-            jitted = jax.jit(step, donate_argnums=(0,))
+            state_sh = dict(in_shardings[0])
+            state_sh.update(in_shardings[1])
+            return _CompiledStep(jitted, (mut_names, ro_names), state_out,
+                                 fetch_names, state_shardings=state_sh,
+                                 feed_shardings=in_shardings[2])
+        jitted = jax.jit(step, donate_argnums=(0,))
         return _CompiledStep(jitted, (mut_names, ro_names), state_out, fetch_names)
 
 

@@ -150,6 +150,8 @@ class Scan:
                                     parent.program.blocks[sub.idx].var(m[1]).shape,
                                     parent.program.blocks[sub.idx].var(m[1]).dtype)
                   for m in self._memories]
+        # final carry values, in memory() declaration order (see final_memory())
+        self.finals = [parent.var(f.name) for f in finals]
         parent.append_op(
             "scan",
             inputs={"Init": [m[0] for m in self._memories],

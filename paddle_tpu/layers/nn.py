@@ -685,11 +685,11 @@ def expand(x, expand_times, name=None):
     return _var(helper, out)
 
 
-def gather(input, index, overwrite=True):
+def gather(input, index, overwrite=True, axis=0):
     helper = LayerHelper("gather")
     out = _out(helper, input.dtype)
     helper.append_op("gather", inputs={"X": [input], "Index": [index]},
-                     outputs={"Out": [out]})
+                     outputs={"Out": [out]}, attrs={"axis": int(axis)})
     return _var(helper, out)
 
 
@@ -870,3 +870,58 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
 
 def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
     return image_resize(input, out_shape, scale, name, "NEAREST")
+
+
+# --------------------------------------------------------------------------------------
+# beam search (reference nn.py:5852 beam_search, beam_search_decode; dense TPU
+# redesign in ops/beam_ops.py)
+# --------------------------------------------------------------------------------------
+
+def beam_search(pre_ids, pre_scores, scores, finished, beam_size, end_id,
+                name=None):
+    """One dense beam step over [B,K] beams; ``scores`` are per-step log-probs
+    [B,K,V]. Returns (selected_ids, selected_scores, parent_idx, finished)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = _out(helper, "int64", stop_gradient=True)
+    sel_scores = _out(helper, scores.dtype, stop_gradient=True)
+    parent = _out(helper, "int32", stop_gradient=True)
+    fin = _out(helper, "bool", stop_gradient=True)
+    helper.append_op("beam_search",
+                     inputs={"PreIds": [pre_ids], "PreScores": [pre_scores],
+                             "Scores": [scores], "Finished": [finished]},
+                     outputs={"SelectedIds": [sel_ids],
+                              "SelectedScores": [sel_scores],
+                              "ParentIdx": [parent], "FinishedOut": [fin]},
+                     attrs={"beam_size": int(beam_size), "end_id": int(end_id)})
+    blk = helper.main_program.current_block()
+    return (blk.var(sel_ids.name), blk.var(sel_scores.name),
+            blk.var(parent.name), blk.var(fin.name))
+
+
+def beam_append(ids_buf, parent, new_ids, step_idx, name=None):
+    """Reorder the [B,K,T] token buffer by parent pointers and write new_ids at
+    column step_idx."""
+    helper = LayerHelper("beam_append", name=name)
+    out = _out(helper, ids_buf.dtype, stop_gradient=True)
+    helper.append_op("beam_append",
+                     inputs={"IdsBuf": [ids_buf], "Parent": [parent],
+                             "NewIds": [new_ids], "StepIdx": [step_idx]},
+                     outputs={"Out": [out]})
+    return _var(helper, out)
+
+
+def beam_search_decode(ids, parents, scores, beam_size=None, end_id=1,
+                       name=None):
+    """Backtrack per-step selections [B,T,K] into sentences [B,K,T] sorted
+    best-first (reference beam_search_decode_op)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = _out(helper, "int64", stop_gradient=True)
+    sscores = _out(helper, scores.dtype, stop_gradient=True)
+    helper.append_op("beam_search_decode",
+                     inputs={"Ids": [ids], "Parents": [parents],
+                             "Scores": [scores]},
+                     outputs={"SentenceIds": [sent],
+                              "SentenceScores": [sscores]},
+                     attrs={"end_id": int(end_id)})
+    blk = helper.main_program.current_block()
+    return blk.var(sent.name), blk.var(sscores.name)

@@ -1,7 +1,8 @@
 """Transformer NMT (reference: tests/unittests/dist_transformer.py / the fluid
 Transformer model). Variable-length sequences use padded [B,S] + mask instead of
-LoDTensor (SURVEY.md §5.7); beam-search decode lowers through lax.while_loop
-(round-2: full beam; this round ships greedy scan decode).
+LoDTensor (SURVEY.md §5.7). ``beam_decode`` is the BASELINE.md "Transformer NMT
++ beam search decode" workload: one jittable Scan over decode steps with dense
+[B,K] beams (ops/beam_ops.py), backtracked by beam_search_decode.
 """
 from __future__ import annotations
 
@@ -118,6 +119,107 @@ def decode(trg_ids, trg_pos, trg_mask, enc_out, src_mask,
                                     f"dec{i}_cross"), cfg)
         dec = _resid_norm(dec, _ffn(dec, cfg, f"dec{i}"), cfg)
     return _fc(dec, cfg.trg_vocab, "proj")    # [B,S,V]
+
+
+def beam_decode(src_ids, src_pos, src_mask, cfg: TransformerConfig,
+                beam_size=4, max_len=16, bos_id=0, eos_id=1):
+    """Beam-search decode (reference layers/nn.py:5852 beam_search +
+    beam_search_decode_op, dist_transformer.py decode path).
+
+    TPU-native shape: the whole decode is ONE jittable program — a Scan over
+    max_len steps carrying dense [B,K] beams; each step re-runs the causal
+    decoder over the (static-length) prefix buffer and takes one top-k over
+    [B, K*V]. Build with cfg.dropout=0 for deterministic decoding.
+
+    Returns (sentence_ids [B,K,max_len], sentence_scores [B,K]) sorted
+    best-first per batch row (bos not included in the output tokens).
+    """
+    import numpy as np
+    from ..layer_helper import LayerHelper
+    from ..framework import default_main_program
+
+    K, T = beam_size, max_len + 1  # buffer holds bos + max_len tokens
+    S, H = src_ids.shape[1], cfg.hidden
+
+    enc_out = encode(src_ids, src_pos, src_mask, cfg)          # [B,S,H]
+
+    # tile batch rows K times (row-major repeat, NOT tile): [B,S,H]->[B*K,S,H]
+    def tile_beams(x, tail_shape):
+        e = layers.unsqueeze(x, [1])
+        e = layers.expand(e, [1, K] + [1] * (len(tail_shape)))
+        return layers.reshape(e, [-1] + list(tail_shape))
+
+    enc_tiled = tile_beams(enc_out, [S, H])
+    src_mask_tiled = tile_beams(src_mask, [S])
+
+    helper = LayerHelper("beam_init")
+    blk = default_main_program().current_block()
+    scores0 = blk.create_var(helper.name + "_scores0", (-1, K), "float32")
+    fin0 = blk.create_var(helper.name + "_fin0", (-1, K), "bool")
+    buf0 = blk.create_var(helper.name + "_buf0", (-1, K, T), "int64")
+    helper.append_op("beam_init", inputs={"BatchRef": [src_ids]},
+                     outputs={"ScoresInit": [scores0], "FinishedInit": [fin0],
+                              "IdsBufInit": [buf0]},
+                     attrs={"beam_size": K, "buf_len": T, "bos_id": bos_id})
+    scores0, fin0, buf0 = blk.var(scores0.name), blk.var(fin0.name), \
+        blk.var(buf0.name)
+    for v in (scores0, fin0, buf0):
+        v.stop_gradient = True
+
+    # per-step scalar t, scanned over axis 1 of a [1, max_len] index row
+    t_seq = layers.assign(np.arange(max_len, dtype="int32").reshape(1, -1))
+    pos_row = layers.assign(np.arange(T, dtype="int64").reshape(1, T))
+    one_i32 = layers.assign(np.ones(1, dtype="int32"))
+
+    scan = layers.Scan()
+    with scan.step():
+        t = scan.step_input(t_seq)                      # [1] int32
+        scores = scan.memory(scores0)                   # [B,K]
+        fin = scan.memory(fin0)                         # [B,K] bool
+        buf = scan.memory(buf0)                         # [B,K,T]
+
+        prefix = layers.reshape(buf, [-1, T])           # [B*K,T]
+        zeros64 = layers.elementwise_mul(prefix, layers.fill_constant(
+            [1], "int64", 0))
+        trg_pos = layers.elementwise_add(zeros64, pos_row)
+        # positions <= t are visible
+        t64 = layers.cast(t, "int64")
+        vis = layers.less_than(trg_pos,
+                               layers.elementwise_add(
+                                   t64, layers.fill_constant([1], "int64", 1)))
+        trg_mask = layers.cast(vis, "float32")          # [B*K,T]
+
+        logits = decode(prefix, trg_pos, trg_mask, enc_tiled,
+                        src_mask_tiled, cfg)            # [B*K,T,V]
+        step_logits = layers.gather(logits, t, axis=1)  # [B*K,1,V]
+        step_logits = layers.squeeze(step_logits, [1])  # [B*K,V]
+        log_probs = layers.log_softmax(step_logits)     # flat; beam_search
+        # unflattens to [B,K,V] against PreScores' beam shape
+
+        sel_ids, sel_scores, parent, fin_new = layers.beam_search(
+            scores, scores, log_probs, fin, K, eos_id)
+        t_next = layers.elementwise_add(t, one_i32)
+        buf_new = layers.beam_append(buf, parent, sel_ids, t_next)
+
+        scan.update_memory(scores, sel_scores)
+        scan.update_memory(fin, fin_new)
+        scan.update_memory(buf, buf_new)
+        scan.step_output(sel_ids)
+        scan.step_output(parent)
+    ids_steps, parent_steps = scan()                    # [1? B, max_len, K]
+    final_scores = scan.finals[0]                       # [B,K]
+
+    sent_ids, sent_scores = layers.beam_search_decode(
+        ids_steps, parent_steps, final_scores, beam_size=K, end_id=eos_id)
+    return sent_ids, sent_scores
+
+
+def greedy_decode(src_ids, src_pos, src_mask, cfg: TransformerConfig,
+                  max_len=16, bos_id=0, eos_id=1):
+    """Greedy decode = beam decode with beam_size 1."""
+    ids, scores = beam_decode(src_ids, src_pos, src_mask, cfg, beam_size=1,
+                              max_len=max_len, bos_id=bos_id, eos_id=eos_id)
+    return ids, scores
 
 
 def transformer(src_ids, src_pos, src_mask, trg_ids, trg_pos, trg_mask,

@@ -1,0 +1,168 @@
+"""Beam-search ops (reference: paddle/fluid/operators/beam_search_op.*,
+beam_search_decode_op.*, python/paddle/fluid/layers/nn.py:5852).
+
+TPU-native redesign: the reference keeps beams in LoDTensors with dynamic widths
+and prunes per step; here beams are dense [B, K] tensors with a static beam
+size, the per-step selection is one top-k over [B, K*V] (an MXU/VPU-friendly
+shape), and the final backtrack is a reverse lax.scan over recorded parent
+pointers -- everything static-shape, so the whole decode jits as one program.
+
+Convention for step 0: initialize pre_scores to [0, -inf, -inf, ...] per batch
+row so identical initial beams don't produce duplicate candidates.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register
+
+_NEG = -1e9
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _mk_var(block, name, shape, dtype):
+    from ..core.registry import EMPTY_VAR
+    from ..framework import convert_dtype
+    if name == EMPTY_VAR:
+        return
+    v = block.find_var_recursive(name)
+    if v is None:
+        v = block.create_var(name, tuple(shape), dtype)
+    else:  # pre-created by the layer helper: fill in inferred shape/dtype
+        v.shape = tuple(shape)
+        v.dtype = convert_dtype(dtype)
+    v.stop_gradient = True
+
+
+def _beam_search_infer(op, block):
+    """Outputs follow PreScores' [B,K] shape (Scores may arrive flat [B*K,V],
+    which eval_shape-based inference cannot unflatten for a dynamic B)."""
+    bk = block.find_var_recursive(op.inputs["PreScores"][0]).shape
+    _mk_var(block, op.outputs["SelectedIds"][0], bk, "int64")
+    _mk_var(block, op.outputs["SelectedScores"][0], bk, "float32")
+    _mk_var(block, op.outputs["ParentIdx"][0], bk, "int32")
+    _mk_var(block, op.outputs["FinishedOut"][0], bk, "bool")
+
+
+@register("beam_search", grad=None, infer_shape=_beam_search_infer,
+          nondiff_inputs=("PreIds", "PreScores", "Scores", "Finished"))
+def beam_search(ctx, ins):
+    """One beam step.
+
+    Inputs: PreScores [B,K] cumulative log-probs; Scores [B,K,V] per-step
+    log-probs; Finished [B,K] bool. (PreIds accepted for reference parity.)
+    Attrs: beam_size (=K), end_id.
+    Outputs: SelectedIds [B,K], SelectedScores [B,K], ParentIdx [B,K] int32,
+    FinishedOut [B,K] bool.
+
+    Finished beams are frozen: their only candidate is end_id at an unchanged
+    score, so they compete with live beams without growing.
+    """
+    import jax
+    jnp = _jnp()
+    pre_scores = ins["PreScores"][0]
+    scores = ins["Scores"][0]
+    finished = ins["Finished"][0].astype(bool)
+    if scores.ndim == 2:
+        # flat [B*K, V] (straight out of the decoder): unflatten against
+        # PreScores' beam shape
+        scores = scores.reshape(pre_scores.shape[0], pre_scores.shape[1], -1)
+    B, K, V = scores.shape
+    end_id = ctx.attr("end_id", 1)
+
+    cand = pre_scores[:, :, None] + scores                       # [B,K,V]
+    cand = jnp.where(finished[:, :, None], _NEG, cand)
+    # finished beams may only re-emit end_id, score unchanged
+    frozen = jnp.where(finished, pre_scores, cand[:, :, end_id])
+    cand = cand.at[:, :, end_id].set(frozen)
+
+    flat = cand.reshape(B, K * V)
+    top_scores, top_idx = jax.lax.top_k(flat, K)                 # [B,K]
+    parent = (top_idx // V).astype("int32")
+    token = (top_idx % V).astype(pre_scores.dtype).astype("int32")
+    par_finished = jnp.take_along_axis(finished, parent, axis=1)
+    new_finished = jnp.logical_or(par_finished, token == end_id)
+    return {"SelectedIds": [token.astype("int64")],
+            "SelectedScores": [top_scores],
+            "ParentIdx": [parent],
+            "FinishedOut": [new_finished]}
+
+
+@register("beam_append", grad=None,
+          nondiff_inputs=("IdsBuf", "Parent", "NewIds", "StepIdx"))
+def beam_append(ctx, ins):
+    """Reorder the per-beam token buffer by parent pointers and write the new
+    tokens at column StepIdx (the dense analog of the reference's LoD beam
+    bookkeeping). IdsBuf [B,K,T], Parent [B,K], NewIds [B,K], StepIdx [1]."""
+    jnp = _jnp()
+    buf = ins["IdsBuf"][0]
+    parent = ins["Parent"][0].astype("int32")
+    new_ids = ins["NewIds"][0].astype(buf.dtype)
+    t = ins["StepIdx"][0].reshape(-1)[0].astype("int32")
+    B, K, T = buf.shape
+    reordered = jnp.take_along_axis(buf, parent[:, :, None], axis=1)
+    col = (jnp.arange(T) == t)                                   # [T]
+    out = jnp.where(col[None, None, :], new_ids[:, :, None], reordered)
+    return {"Out": [out]}
+
+
+@register("beam_search_decode", grad=None,
+          nondiff_inputs=("Ids", "Parents", "Scores"))
+def beam_search_decode(ctx, ins):
+    """Backtrack recorded beams to full sequences (reference
+    beam_search_decode_op.*). Ids/Parents [B,T,K] per-step selections; Scores
+    [B,K] final cumulative scores. Outputs SentenceIds [B,K,T] (tokens after
+    the first end_id are end_id) and SentenceScores [B,K] sorted best-first."""
+    import jax
+    jnp = _jnp()
+    ids = ins["Ids"][0]          # [B,T,K]
+    parents = ins["Parents"][0]  # [B,T,K]
+    scores = ins["Scores"][0]    # [B,K]
+    end_id = ctx.attr("end_id", 1)
+    B, T, K = ids.shape
+
+    beam0 = jnp.broadcast_to(jnp.arange(K, dtype="int32")[None, :], (B, K))
+
+    def back(beam, t):
+        tok = jnp.take_along_axis(ids[:, t, :], beam, axis=1)      # [B,K]
+        beam_prev = jnp.take_along_axis(parents[:, t, :].astype("int32"),
+                                        beam, axis=1)
+        return beam_prev, tok
+
+    _, toks = jax.lax.scan(back, beam0, jnp.arange(T - 1, -1, -1))
+    seqs = jnp.flip(jnp.swapaxes(toks, 0, 1), axis=1)              # [B,T,K]
+    seqs = jnp.swapaxes(seqs, 1, 2)                                # [B,K,T]
+    # clamp everything after the first end_id to end_id
+    is_end = (seqs == end_id)
+    seen = jnp.cumsum(is_end.astype("int32"), axis=-1)
+    seqs = jnp.where(seen - is_end.astype("int32") > 0, end_id, seqs)
+    # sort beams best-first
+    order = jnp.argsort(-scores, axis=1).astype("int32")           # [B,K]
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    sorted_scores = jnp.take_along_axis(scores, order, axis=1)
+    return {"SentenceIds": [seqs.astype("int64")],
+            "SentenceScores": [sorted_scores]}
+
+
+@register("beam_init", grad=None, nondiff_inputs=("BatchRef",))
+def beam_init(ctx, ins):
+    """Initial beam state from a batch-reference tensor (BatchRef [B, ...]).
+
+    Attrs: beam_size K, buf_len T, bos_id. Outputs: ScoresInit [B,K]
+    (0 for beam 0, -1e9 for the rest, so identical initial beams don't yield
+    duplicate candidates), FinishedInit [B,K] false, IdsBufInit [B,K,T] bos.
+    """
+    jnp = _jnp()
+    ref = ins["BatchRef"][0]
+    B = ref.shape[0]
+    K = ctx.attr("beam_size")
+    T = ctx.attr("buf_len")
+    bos = ctx.attr("bos_id", 0)
+    row = jnp.full((K,), _NEG, "float32").at[0].set(0.0)
+    return {"ScoresInit": [jnp.broadcast_to(row, (B, K))],
+            "FinishedInit": [jnp.zeros((B, K), bool)],
+            "IdsBufInit": [jnp.full((B, K, T), bos, "int64")]}

@@ -482,6 +482,149 @@ def _rewrite_recompute(program: Program, checkpoint_names):
     program._bump()
 
 
+class PipelineOptimizer:
+    """GPipe-style pipeline trainer (reference optimizer.py:2985
+    PipelineOptimizer, framework/trainer.h:115 PipelineTrainer,
+    section_worker.cc:85 SectionWorker).
+
+    TPU-native redesign: the reference cuts the program into per-device
+    sections and streams Scopes between SectionWorker threads over NCCL. Here
+    ``minimize`` rewrites the program into a **microbatch scan**: the feed
+    batch splits into ``num_microbatches`` slices, one ``lax.scan`` runs
+    forward+backward per slice accumulating gradients functionally, and the
+    wrapped optimizer applies the averaged gradient once -- the same math as
+    the reference's grad-merged pipeline schedule, in one XLA program.
+    Cross-stage placement over a "pp" mesh axis is expressed separately with
+    DistributedStrategy sharding rules (and parallel/pipeline.py carries the
+    explicit shard_map/ppermute schedule for homogeneous layer stacks).
+
+    Feed batch sizes must be divisible by num_microbatches.
+    """
+
+    def __init__(self, optimizer, num_microbatches=1, cut_list=None,
+                 place_list=None, concurrency_list=None, queue_size=None,
+                 sync_steps=None, start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._m = int(num_microbatches)
+        # cut/place/concurrency/queue knobs are the reference's thread-section
+        # tuning surface; scheduling is XLA's job here.
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program, parameter_list,
+                                        no_grad_set, callbacks)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework import program_guard
+        program = loss.block.program
+        block = program.global_block()
+        with program_guard(program,
+                           startup_program or default_startup_program()):
+            params_grads = self._optimizer.backward(
+                loss, startup_program, parameter_list, no_grad_set)
+            if self._m <= 1:
+                ops = self._optimizer.apply_gradients(params_grads)
+                return ops, params_grads
+            mean_grads = _rewrite_microbatch_scan(program, loss, params_grads,
+                                                  self._m)
+            pg = [(p, mean_grads[p.name]) for p, g in params_grads
+                  if g is not None]
+            ops = self._optimizer.apply_gradients(pg)
+        return ops, params_grads
+
+
+def _rewrite_microbatch_scan(program: Program, loss, params_grads, M):
+    """Move all ops built so far (forward + backward) into a sub-block scanned
+    over M microbatch slices; return {param_name: mean-grad Variable}."""
+    block = program.global_block()
+    fwd_bwd_ops = list(block.ops)
+    block.ops = []
+
+    # data vars the step consumes (is_data) become scanned sequences
+    data_names = []
+    for op in fwd_bwd_ops:
+        for n in op.input_arg_names():
+            v = block.find_var_recursive(n)
+            if v is not None and v.is_data and n not in data_names:
+                data_names.append(n)
+
+    sub = program._create_block(parent_idx=0)
+    sub.ops = fwd_bwd_ops
+    program._rollback()
+
+    carry_names, init_names, final_names = [], [], []
+
+    def add_carry(inner_name, shape, dtype, add_name, zero_like=None):
+        """Accumulator carried across microbatches: inner += add_name."""
+        sub.create_var(inner_name, tuple(shape), dtype).stop_gradient = True
+        sub.append_op("sum", inputs={"X": [inner_name, add_name]},
+                      outputs={"Out": [inner_name]}, infer_shape=False)
+        zname = inner_name + "@zero"
+        zv = block.create_var(zname, tuple(shape), dtype)
+        zv.stop_gradient = True
+        if zero_like is not None:
+            block.append_op("fill_zeros_like", inputs={"X": [zero_like]},
+                            outputs={"Out": [zname]}, infer_shape=False)
+        else:
+            block.append_op("fill_constant", outputs={"Out": [zname]},
+                            attrs={"shape": [int(s) for s in shape],
+                                   "value": 0.0, "dtype": dtype},
+                            infer_shape=False)
+        fname = inner_name + "@final"
+        block.create_var(fname, tuple(shape), dtype).stop_gradient = True
+        carry_names.append(inner_name)
+        init_names.append(zname)
+        final_names.append(fname)
+        return fname
+
+    grad_finals = {}
+    for p, g in params_grads:
+        if g is None:
+            continue
+        gd = getattr(g, "dtype", "float32")
+        grad_finals[p.name] = add_carry(g.name + "@mb_acc", p.shape, gd,
+                                        g.name, zero_like=p.name)
+    loss_final = add_carry(loss.name + "@mb_acc", (1,), "float32", loss.name)
+
+    mb_names = []
+    for dn in data_names:
+        v = block.var(dn)
+        tail = [int(s) for s in v.shape[1:]]
+        out = block.create_var(dn + "@mb", tuple([M, -1] + tail), v.dtype)
+        out.stop_gradient = True
+        block.append_op("reshape", inputs={"X": [dn]},
+                        outputs={"Out": [out.name]},
+                        attrs={"shape": [M, -1] + tail}, infer_shape=False)
+        mb_names.append(out.name)
+
+    block.append_op("scan",
+                    inputs={"Init": init_names, "X": mb_names},
+                    outputs={"Out": [], "FinalCarry": final_names},
+                    attrs={"sub_block": sub.idx, "carry_names": carry_names,
+                           "x_names": data_names, "out_names": [],
+                           "time_major": True},
+                    infer_shape=False)
+
+    mean_grads = {}
+    for p, g in params_grads:
+        if g is None:
+            continue
+        mname = g.name + "@mb_mean"
+        mv = block.create_var(mname, tuple(p.shape),
+                              getattr(g, "dtype", "float32"))
+        mv.stop_gradient = True
+        block.append_op("scale", inputs={"X": [grad_finals[p.name]]},
+                        outputs={"Out": [mname]},
+                        attrs={"scale": 1.0 / M}, infer_shape=False)
+        mean_grads[p.name] = block.var(mname)
+    # the user-facing loss var becomes the microbatch-mean loss
+    block.append_op("scale", inputs={"X": [loss_final]},
+                    outputs={"Out": [loss.name]},
+                    attrs={"scale": 1.0 / M}, infer_shape=False)
+    return mean_grads
+
+
 class ExponentialMovingAverage:
     """EMA shadow parameters (reference optimizer.py:2449).
 

@@ -1,0 +1,13 @@
+"""Distributed runtime: multi-host bootstrap, explicit pipeline schedules,
+launch helpers (reference: paddle/fluid/operators/collective/,
+python/paddle/distributed/, platform/nccl_helper.h).
+
+The data plane is XLA collectives over ICI/DCN compiled in by GSPMD
+(compiler.py DistributedStrategy); this package holds what remains host-side:
+process bootstrap (env.py, the gen_nccl_id analog), explicit shard_map
+schedules that GSPMD cannot infer (pipeline.py), and process launching
+(launch.py).
+"""
+from .env import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
+                  local_device_count, global_mesh, ParallelEnv)
+from .pipeline import pipeline_spmd  # noqa: F401

@@ -1,0 +1,153 @@
+"""Multi-host bootstrap (the gen_nccl_id / NCCLContextMap analog).
+
+Reference: paddle/fluid/operators/collective/c_gen_nccl_id_op.cc:56 (rank 0
+RPC-serves the ncclUniqueId), platform/nccl_helper.h:179-314 (ring setup),
+python/paddle/distributed/launch.py:147 (per-process env), fleet role makers.
+
+TPU-native: there are no rings to build -- ``jax.distributed.initialize``
+connects the hosts (coordinator address = the genNcclId analog), after which
+``jax.devices()`` spans all hosts and GSPMD compiles collectives onto ICI
+within a slice and DCN across slices. What this module adds on top:
+
+* env-var role discovery matching the reference's launcher contract
+  (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS, plus
+  the native COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID),
+* a ``global_mesh`` helper that builds a (host, device) factored mesh so
+  hierarchical reduction = mesh-axis-factored psum over ("host", axis) --
+  the 2-level NCCL hierarchy (nccl_helper.h:246) expressed as sharding,
+* per-host feed sharding arithmetic used by reader.shard() / Executor.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Role info for this process (reference fleet role_maker / ParallelEnv)."""
+
+    def __init__(self):
+        self.rank = get_rank()
+        self.world_size = get_world_size()
+        self.dev_id = int(os.environ.get("FLAGS_selected_tpus", "0"))
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+
+def _env_int(*names, default=0) -> int:
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return int(v)
+    return default
+
+
+def get_rank() -> int:
+    """Process index: native PROCESS_ID, reference PADDLE_TRAINER_ID."""
+    import jax
+    if _initialized:
+        return jax.process_index()
+    return _env_int("PROCESS_ID", "PADDLE_TRAINER_ID", default=0)
+
+
+def get_world_size() -> int:
+    import jax
+    if _initialized:
+        return jax.process_count()
+    n = _env_int("NUM_PROCESSES", "PADDLE_TRAINERS_NUM", default=0)
+    if n:
+        return n
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return len(eps.split(",")) if eps else 1
+
+
+def _coordinator() -> Optional[str]:
+    addr = os.environ.get("COORDINATOR_ADDRESS")
+    if addr:
+        return addr
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    if eps:
+        return eps.split(",")[0]  # rank-0 endpoint serves as coordinator
+    return None
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> ParallelEnv:
+    """Connect this host into the job (the c_gen_nccl_id + c_comm_init analog).
+
+    Single-process (no coordinator configured) is a no-op so the same training
+    script runs unmodified on one host -- matching the reference's behavior
+    when trainers_num == 1 (distribute_transpiler.py:308).
+    """
+    global _initialized
+    import jax
+    if _initialized:
+        return ParallelEnv()
+    addr = coordinator_address or _coordinator()
+    n = num_processes if num_processes is not None else get_world_size()
+    if addr is None or n <= 1:
+        return ParallelEnv()  # single-host: nothing to bootstrap
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=n,
+        process_id=process_id if process_id is not None else get_rank())
+    _initialized = True
+    return ParallelEnv()
+
+
+def local_device_count() -> int:
+    import jax
+    return jax.local_device_count()
+
+
+def global_mesh(mesh_shape: Dict[str, int] = None, hierarchical=False):
+    """Build a Mesh over ALL hosts' devices.
+
+    With hierarchical=True, prepend a "host" axis of size process_count so
+    reductions factor into (intra-host over ICI, inter-host over DCN) -- the
+    TPU expression of hierarchical allreduce (nccl_helper.h:246): psum over
+    a ("host", "dp") spec IS the 2-level reduction, scheduled by XLA.
+    """
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = {"dp": len(devices)}
+    mesh_shape = dict(mesh_shape)
+    if hierarchical:
+        nh = jax.process_count()
+        mesh_shape = {"host": nh,
+                      **{k: (v // nh if k == "dp" else v)
+                         for k, v in mesh_shape.items()}}
+    sizes = list(mesh_shape.values())
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh {mesh_shape} needs {n} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(sizes)
+    return Mesh(arr, tuple(mesh_shape))
+
+
+def shard_batch(array, rank: Optional[int] = None,
+                world_size: Optional[int] = None):
+    """Per-host feed slice: host r feeds rows [r*B/W, (r+1)*B/W) of the global
+    batch (the reference's per-trainer feed split, executor.py:618)."""
+    r = rank if rank is not None else get_rank()
+    w = world_size if world_size is not None else get_world_size()
+    if w <= 1:
+        return array
+    b = array.shape[0]
+    if b % w != 0:
+        raise ValueError(f"global batch {b} not divisible by {w} hosts")
+    per = b // w
+    return array[r * per:(r + 1) * per]

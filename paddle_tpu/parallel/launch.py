@@ -1,0 +1,70 @@
+"""Multi-process launcher (reference python/paddle/distributed/launch.py:147).
+
+Spawns one training process per host-slot with the env-var contract that
+parallel/env.py reads (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID, plus
+the reference-compatible PADDLE_TRAINER_* names). On a real TPU pod each host
+runs one process (the TPU runtime owns all local chips); this launcher exists
+for localhost simulation and CPU-mesh testing::
+
+    python -m paddle_tpu.parallel.launch --nproc 2 train.py --lr 0.1
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(nproc: int, script_argv, coordinator: str = None,
+           devices_per_proc: int = None):
+    """Spawn ``nproc`` copies of ``script_argv``; returns exit codes."""
+    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    endpoints = ",".join(coordinator for _ in range(nproc))
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": coordinator,
+            "NUM_PROCESSES": str(nproc),
+            "PROCESS_ID": str(rank),
+            # reference launcher contract (distributed/launch.py:147)
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": coordinator,
+        })
+        if devices_per_proc:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count="
+                                f"{devices_per_proc}").strip()
+        procs.append(subprocess.Popen([sys.executable] + list(script_argv),
+                                      env=env))
+    return [p.wait() for p in procs]
+
+
+def main():
+    ap = argparse.ArgumentParser("paddle_tpu.parallel.launch")
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--devices_per_proc", type=int, default=None)
+    ap.add_argument("script", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.script:
+        ap.error("no training script given")
+    codes = launch(args.nproc, args.script, args.coordinator,
+                   args.devices_per_proc)
+    sys.exit(max(codes))
+
+
+if __name__ == "__main__":
+    main()

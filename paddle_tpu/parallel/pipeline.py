@@ -1,0 +1,83 @@
+"""Explicit GPipe schedule over a "pp" mesh axis via shard_map + ppermute.
+
+Reference: PipelineTrainer/SectionWorker (framework/trainer.h:115,
+section_worker.cc:85,141) stream Scopes between per-device section threads.
+TPU-native: the schedule is *compiled* -- each device holds one stage's
+parameters (the stage axis of a stacked pytree is sharded over "pp"),
+activations flow to the next device with lax.ppermute, and the classic GPipe
+skew fills/drains the pipeline over M + S - 1 ticks inside one lax.scan.
+GSPMD cannot infer temporal schedules like this, hence shard_map.
+
+Requires homogeneous stages (activation shape preserved), the natural shape
+for transformer/BERT layer stacks. For the general heterogeneous-program
+microbatch path use fluid.optimizer.PipelineOptimizer (a program rewrite).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x, mesh,
+                  axis: str = "pp"):
+    """Run a homogeneous S-stage pipeline over microbatches.
+
+    stage_fn(params_one_stage, x_mb) -> y_mb with y.shape == x.shape.
+    stacked_params: pytree whose leaves have a leading stage axis S
+        (sharded over ``axis`` on ``mesh``).
+    x: [M, mb, ...] microbatches (replicated).
+    Returns [M, mb, ...] outputs after all S stages (replicated).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    S = mesh.shape[axis]
+    M = x.shape[0]
+
+    def per_device(params, xs):
+        # params leaves: [1, ...] local stage slice; xs: [M, mb, ...]
+        idx = jax.lax.axis_index(axis)
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        state0 = jnp.zeros_like(xs[0])
+        outbuf0 = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state, outbuf = carry
+            # stage 0 consumes microbatch t while t < M; later stages consume
+            # what arrived from the previous device
+            feed_idx = jnp.clip(t, 0, M - 1)
+            inp = jnp.where(idx == 0, xs[feed_idx], state)
+            y = stage_fn(local, inp)
+            # last stage emits microbatch t-(S-1) once the pipe is full
+            out_t = t - (S - 1)
+            emit = jnp.logical_and(idx == S - 1, out_t >= 0)
+            outbuf = jax.lax.cond(
+                emit,
+                lambda ob: jax.lax.dynamic_update_index_in_dim(
+                    ob, y, jnp.maximum(out_t, 0), 0),
+                lambda ob: ob, outbuf)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, outbuf), None
+
+        (_, outbuf), _ = jax.lax.scan(tick, (state0, outbuf0),
+                                      jnp.arange(M + S - 1))
+        # replicate the last stage's buffer to every device
+        mask = (idx == S - 1).astype(outbuf.dtype)
+        return jax.lax.psum(outbuf * mask, axis)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    try:
+        fn = shard_map(per_device, mesh=mesh,
+                       in_specs=(pspec, P()), out_specs=P(),
+                       check_vma=False)
+    except TypeError:  # pre-0.8 jax spells it check_rep
+        fn = shard_map(per_device, mesh=mesh,
+                       in_specs=(pspec, P()), out_specs=P(),
+                       check_rep=False)
+    return fn(stacked_params, x)

@@ -1,0 +1,64 @@
+"""Multi-process distributed trainer script (the reference's dist_mnist.py
+runtime_main pattern, tests/unittests/test_dist_base.py:409): launched by
+test_multihost.py as N processes on localhost; prints per-step losses as JSON
+on the last stdout line for the parent to compare against the single-process
+baseline."""
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import env as penv
+
+    if nproc > 1:
+        penv.init_parallel_env(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=rank)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 21
+    startup.random_seed = 21
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        x = fluid.data("x", [32], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(x, 64, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+
+    cp = fluid.CompiledProgram(main_p).with_data_parallel(loss_name=loss.name)
+
+    rng = np.random.RandomState(0)  # same global batch stream on every rank
+    W = rng.randn(32, 10).astype("float32")
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(5):
+            gb = 64
+            gx = rng.randn(gb, 32).astype("float32")
+            gy = np.argmax(gx @ W, 1)[:, None].astype("int64")
+            # per-host slice of the global batch
+            lx = penv.shard_batch(gx, rank, nproc)
+            ly = penv.shard_batch(gy, rank, nproc)
+            lv, = exe.run(cp, feed={"x": lx, "label": ly}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    print("LOSSES:" + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Multi-host simulation tests (VERDICT r1 #2; reference
+tests/unittests/test_dist_base.py:637 _run_cluster): launch N local processes
+with subprocess.Popen, each a jax.distributed participant with 4 forced CPU
+devices, and assert the 2-process dp8 losses match the single-process dp8 run.
+
+Also covers the explicit shard_map GPipe schedule (parallel/pipeline.py) and
+the hierarchical (host, dp)-factored mesh helper.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_RUNNER = os.path.join(os.path.dirname(__file__), "dist_mlp_runner.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(nproc, port):
+    procs = []
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    for r in range(nproc):
+        procs.append(subprocess.Popen(
+            [sys.executable, _RUNNER, str(r), str(nproc), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, (
+            f"rank process failed rc={p.returncode}:\n"
+            f"{err.decode()[-2000:]}")
+        outs.append(out.decode())
+    return outs
+
+
+def _losses(out):
+    for line in out.splitlines():
+        if line.startswith("LOSSES:"):
+            return json.loads(line[len("LOSSES:"):])
+    raise AssertionError(f"no LOSSES line in output: {out[-500:]}")
+
+
+def test_two_process_dp_matches_single_process():
+    """2 hosts x 4 devices dp8 == 1 host x 8 devices dp8, same global batch."""
+    single = _losses(_launch(1, _free_port())[0])
+    outs = _launch(2, _free_port())
+    l0, l1 = _losses(outs[0]), _losses(outs[1])
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)   # ranks agree
+    np.testing.assert_allclose(single, l0, rtol=2e-4, atol=1e-5)
+
+
+def test_pipeline_spmd_matches_serial():
+    """Explicit GPipe over pp=4: outputs equal serial stage application."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel import pipeline_spmd
+
+    S, M, MB, D = 4, 6, 2, 8
+    rng = np.random.RandomState(0)
+    Ws = rng.randn(S, D, D).astype("float32") * 0.3
+    bs = rng.randn(S, D).astype("float32") * 0.1
+    x = rng.randn(M, MB, D).astype("float32")
+
+    def stage(params, h):
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    mesh = Mesh(np.array(jax.devices()[:S]).reshape(S), ("pp",))
+    out = pipeline_spmd(stage, (jnp.asarray(Ws), jnp.asarray(bs)),
+                        jnp.asarray(x), mesh, axis="pp")
+
+    ref = x.copy()
+    for s in range(S):
+        ref = np.tanh(ref @ Ws[s] + bs[s])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=1e-5)
+
+
+def test_hierarchical_mesh_helper():
+    from paddle_tpu.parallel import env as penv
+    mesh = penv.global_mesh({"dp": 8}, hierarchical=False)
+    assert mesh.shape == {"dp": 8}
+    # hierarchical with one process: host axis of size 1
+    mesh2 = penv.global_mesh({"dp": 8}, hierarchical=True)
+    assert mesh2.shape["host"] == 1 and mesh2.shape["dp"] == 8
+
+
+def test_shard_batch():
+    from paddle_tpu.parallel.env import shard_batch
+    x = np.arange(12).reshape(12, 1)
+    np.testing.assert_array_equal(shard_batch(x, 1, 3), x[4:8])
+    np.testing.assert_array_equal(shard_batch(x, 0, 1), x)

@@ -127,3 +127,66 @@ def test_tensor_parallel_fc():
         got, = exe.run(cp, feed={"x": xv}, fetch_list=[loss2])
 
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+
+
+def test_reduce_strategy_zero_shards_optimizer_state():
+    """BuildStrategy.ReduceStrategy.Reduce: optimizer accumulators are
+    partitioned over dp (ZeRO) with loss parity vs AllReduce mode."""
+    import jax
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 13
+        startup.random_seed = 13
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [32], "float32")
+            label = fluid.data("label", [1], "int64")
+            h = fluid.layers.fc(x, 64, act="relu")
+            logits = fluid.layers.fc(h, 8)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        return main, startup, loss
+
+    def train(cp, startup, loss, grab=None):
+        rng = np.random.RandomState(5)
+        exe = fluid.Executor()
+        out = []
+        with fluid.scope_guard(fluid.Scope()):
+            sc = fluid.global_scope()
+            exe.run(startup)
+            for _ in range(4):
+                x = rng.randn(16, 32).astype("float32")
+                y = rng.randint(0, 8, (16, 1)).astype("int64")
+                lv, = exe.run(cp, feed={"x": x, "label": y},
+                              fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(())))
+            grabbed = sc.find_var(grab) if grab else None
+        return out, grabbed
+
+    # moment accumulator name for the first fc weight under Adam
+    main, startup, loss = build()
+    moment_name = next(n for n in
+                       (v.name for v in main.list_vars())
+                       if "moment" in n and "fc_0.w_0" in n)
+
+    cp_ar = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    ref, m_ar = train(cp_ar, startup, loss, grab=moment_name)
+
+    main2, startup2, loss2 = build()
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    cp_red = fluid.CompiledProgram(main2, build_strategy=bs)\
+        .with_data_parallel(loss_name=loss2.name)
+    got, m_red = train(cp_red, startup2, loss2, grab=moment_name)
+
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+
+    # AllReduce mode: every device holds the full accumulator.
+    # Reduce mode: each device holds a 1/dp shard (ZeRO memory win).
+    full = int(np.prod(m_ar.shape))
+    ar_shard = int(np.prod(m_ar.addressable_shards[0].data.shape))
+    red_shard = int(np.prod(m_red.addressable_shards[0].data.shape))
+    assert ar_shard == full
+    assert red_shard == full // len(jax.devices())

@@ -1,0 +1,91 @@
+"""Pipeline parallelism tests (VERDICT r1 #3; reference optimizer.py:2985
+PipelineOptimizer + section_worker.cc): microbatch-scan rewrite must match the
+non-pipelined run exactly (grad-mean == full-batch grad for mean losses), and
+compose with a pp mesh axis."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _mlp(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [16], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        h = fluid.layers.fc(h, 32, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+    return main, startup, loss
+
+
+def _train(main, startup, loss, program_for_run=None, steps=6, bs=16):
+    rng = np.random.RandomState(1)
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            x = rng.randn(bs, 16).astype("float32")
+            y = rng.randint(0, 4, (bs, 1)).astype("int64")
+            lv, = exe.run(program_for_run or main,
+                          feed={"x": x, "label": y}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    return losses
+
+
+def test_pipeline_loss_parity_vs_plain():
+    main, startup, loss = _mlp()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    ref = _train(main, startup, loss)
+
+    main2, startup2, loss2 = _mlp()
+    with fluid.program_guard(main2, startup2):
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), num_microbatches=4)
+        opt.minimize(loss2)
+    got = _train(main2, startup2, loss2)
+
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_momentum_parity():
+    """Stateful optimizer through the pipeline rewrite."""
+    main, startup, loss = _mlp(seed=9)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    ref = _train(main, startup, loss)
+
+    main2, startup2, loss2 = _mlp(seed=9)
+    with fluid.program_guard(main2, startup2):
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.Momentum(0.05, 0.9), num_microbatches=2)
+        opt.minimize(loss2)
+    got = _train(main2, startup2, loss2)
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_with_pp_mesh_axis():
+    """Pipelined program trains under a dp x pp mesh (pp shards the hidden
+    dim of the stack weights — placement analog under GSPMD)."""
+    main, startup, loss = _mlp(seed=11)
+    with fluid.program_guard(main, startup):
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), num_microbatches=2)
+        opt.minimize(loss)
+
+    strat = fluid.DistributedStrategy(
+        mesh_shape={"dp": 2, "pp": 4},
+        param_rules=[(r"fc_1\.w", (None, "pp"))])
+    cp = fluid.CompiledProgram(main).with_strategy(strat)
+    got = _train(main, startup, loss, program_for_run=cp)
+
+    main2, startup2, loss2 = _mlp(seed=11)
+    with fluid.program_guard(main2, startup2):
+        fluid.optimizer.SGD(0.1).minimize(loss2)
+    ref = _train(main2, startup2, loss2)
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
