@@ -564,7 +564,8 @@ class Program:
         return "\n".join(str(b) for b in self.blocks)
 
 
-_IS_TEST_OPS = {"dropout", "batch_norm", "sync_batch_norm", "lrn"}
+_IS_TEST_OPS = {"dropout", "batch_norm", "sync_batch_norm", "lrn",
+                "fused_attention"}
 
 
 # --------------------------------------------------------------------------------------

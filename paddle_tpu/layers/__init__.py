@@ -14,3 +14,4 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
 from .detection import *     # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .rnn import *           # noqa: F401,F403
+from . import collective     # noqa: F401

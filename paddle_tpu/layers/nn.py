@@ -925,3 +925,26 @@ def beam_search_decode(ids, parents, scores, beam_size=None, end_id=1,
                      attrs={"end_id": int(end_id)})
     blk = helper.main_program.current_block()
     return blk.var(sent.name), blk.var(sscores.name)
+
+
+def fused_attention(q, k, v, bias=None, scale=None, dropout_prob=0.0,
+                    causal=False, is_test=False, impl="auto", name=None):
+    """Fused scaled-dot-product attention over head-split tensors.
+
+    q/k/v: [B, heads, S, D]; bias: optional [B, 1, 1, S] additive mask. Lowers
+    to one flash-attention Pallas kernel on TPU (ops/pallas_attention.py); the
+    composed softmax(QK^T)V path otherwise. Reference analog: the subgraph that
+    multihead_matmul_fuse_pass.cc:1 pattern-matches, exposed as one op.
+    """
+    helper = LayerHelper("fused_attention", name=name)
+    out = _out(helper, q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op("fused_attention", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"scale": float(scale) if scale else 0.0,
+                            "dropout_prob": float(dropout_prob),
+                            "causal": bool(causal), "is_test": bool(is_test),
+                            "impl": impl})
+    return _var(helper, out)

@@ -23,7 +23,7 @@ from ..initializer import Normal, Constant
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, n_layers=12, n_heads=12,
                  ffn_hidden=None, max_seq_len=512, type_vocab=2, dropout=0.1,
-                 dtype="float32"):
+                 dtype="float32", attn_impl="auto"):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.n_layers = n_layers
@@ -33,6 +33,7 @@ class BertConfig:
         self.type_vocab = type_vocab
         self.dropout = dropout
         self.dtype = dtype
+        self.attn_impl = attn_impl  # "auto" | "pallas" | "composed"
 
 
 def base_config(**kw):
@@ -59,15 +60,23 @@ def attention(x, cfg: BertConfig, mask_bias, name):
         return layers.transpose(t, [0, 2, 1, 3])
 
     q, k, v = to_heads(q), to_heads(k), to_heads(v)
-    scores = layers.matmul(q, k, transpose_y=True,
-                           alpha=1.0 / math.sqrt(d_head))      # [B,h,S,S]
-    if mask_bias is not None:
-        scores = layers.elementwise_add(scores, mask_bias)
-    probs = layers.softmax(scores)
-    if cfg.dropout:
-        probs = layers.dropout(probs, cfg.dropout,
-                               dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(probs, v)                              # [B,h,S,d]
+    if cfg.attn_impl == "composed":
+        scores = layers.matmul(q, k, transpose_y=True,
+                               alpha=1.0 / math.sqrt(d_head))  # [B,h,S,S]
+        if mask_bias is not None:
+            scores = layers.elementwise_add(scores, mask_bias)
+        probs = layers.softmax(scores)
+        if cfg.dropout:
+            probs = layers.dropout(probs, cfg.dropout,
+                                   dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(probs, v)                          # [B,h,S,d]
+    else:
+        # One fused flash-attention op (Pallas kernel on TPU); attention-prob
+        # dropout happens in-kernel with the step PRNG.
+        ctx = layers.fused_attention(q, k, v, bias=mask_bias,
+                                     scale=1.0 / math.sqrt(d_head),
+                                     dropout_prob=cfg.dropout,
+                                     impl=cfg.attn_impl)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, -1, B_H])
     return _dense(ctx, B_H, name + "_out")

@@ -21,3 +21,4 @@ from . import sequence_ops   # noqa: F401
 from . import collective     # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import beam_ops       # noqa: F401
+from . import pallas_attention  # noqa: F401

@@ -1,0 +1,2 @@
+from . import flops  # noqa: F401
+from .flops import program_flops, device_peak_flops  # noqa: F401
