@@ -47,13 +47,17 @@ class LowerCtx:
     """
 
     def __init__(self, attrs: dict, base_key=None, salt: int = 0, block_runner=None,
-                 program=None, mesh=None):
+                 program=None, mesh=None, gspmd_mesh=None):
         self.attrs = attrs
         self._base_key = base_key
         self._salt = salt
         self.block_runner = block_runner
         self.program = program
         self.mesh = mesh  # set when lowering inside shard_map (SPMD)
+        # set when lowering inside a GSPMD jit over a mesh (NOT inside
+        # shard_map): ops may open their own shard_map islands over it
+        # (ring attention) but must NOT call axis primitives directly
+        self.gspmd_mesh = gspmd_mesh
 
     def attr(self, name, default=None):
         return self.attrs.get(name, default)
