@@ -114,7 +114,7 @@ class _CompiledStep:
 
 
 def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
-                mesh=None, stop_at: Optional[int] = None):
+                mesh=None, stop_at: Optional[int] = None, gspmd_mesh=None):
     """Execute/trace the ops of ``block`` over ``env`` (name -> jax value).
 
     This is the single place op lowerings are invoked -- used by the jitted whole-program
@@ -139,7 +139,8 @@ def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
         salt_name = op.attr("__fwd_out0__") or next(
             (ns[0] for ns in op.outputs.values() if ns and ns[0] != EMPTY_VAR), op.type)
         ctx = LowerCtx(op.attrs, base_key, stable_salt(salt_name),
-                       block_runner=block_runner, program=block.program, mesh=mesh)
+                       block_runner=block_runner, program=block.program, mesh=mesh,
+                       gspmd_mesh=gspmd_mesh)
         try:
             outs = d.lower(ctx, ins)
         except Exception as e:
@@ -346,6 +347,10 @@ class Executor:
         # eval programs can share the same Scope entries.
         mut_names = [n for n in state_in if n in state_out]
         ro_names = [n for n in state_in if n not in state_out]
+        # When jitting over a mesh, ops may open shard_map islands over it
+        # (ring attention over "sp"); they see it via LowerCtx.gspmd_mesh.
+        gmesh = (wrapper.mesh if wrapper is not None and
+                 wrapper.dist_strategy is not None else None)
 
         def step(mut_state, ro_state, feed, rng):
             env: Dict[str, Any] = {}
@@ -360,9 +365,10 @@ class Executor:
                 sub_block = program.blocks[idx]
                 merged = dict(env)
                 merged.update(sub_env)
-                return trace_block(sub_block, merged, key, block_runner)
+                return trace_block(sub_block, merged, key, block_runner,
+                                   gspmd_mesh=gmesh)
 
-            trace_block(block, env, rng, block_runner)
+            trace_block(block, env, rng, block_runner, gspmd_mesh=gmesh)
             fetches = []
             for n in fetch_names:
                 if n not in env:

@@ -286,7 +286,13 @@ def fused_attention(ctx, ins):
 
     Inputs: Q/K/V [B, heads, S, D]; optional Bias [B, 1, 1, S] additive (already
     -inf-masked). Attrs: scale (default 1/sqrt(D)), dropout_prob, causal,
-    is_test, impl ('auto' | 'pallas' | 'composed').
+    is_test, impl ('auto' | 'pallas' | 'ring' | 'composed').
+
+    Kernel choice: under a GSPMD jit whose mesh has an "sp" axis >1 (sequence
+    parallelism), 'auto' opens the ring-attention shard_map island
+    (parallel/ring_attention.py) so the sequence dim STAYS partitioned --
+    GSPMD alone would all-gather K/V to every device. Otherwise 'auto' is the
+    Pallas flash kernel on TPU-supported shapes, else the composed jnp path.
     """
     import jax
     import jax.numpy as jnp
@@ -299,6 +305,22 @@ def fused_attention(ctx, ins):
     causal = bool(ctx.attr("causal", False))
     impl = ctx.attr("impl", "auto")
     is_tpu = jax.default_backend() == "tpu"
+
+    gm = ctx.gspmd_mesh
+    sp_n = gm.shape.get("sp", 1) if gm is not None else 1
+    ring_ok = sp_n > 1 and S % sp_n == 0 and (
+        bias is None or (len(bias.shape) == 4 and bias.shape[1] == 1
+                         and bias.shape[2] == 1))
+    if impl == "ring" and not ring_ok:
+        raise ValueError(
+            f"fused_attention impl='ring' needs a GSPMD mesh with sp>1 "
+            f"dividing S and a [B,1,1,S] bias; got sp={sp_n}, S={S}, "
+            f"bias={None if bias is None else bias.shape}")
+    if ring_ok and impl in ("auto", "ring"):
+        from ..parallel import ring_attention as _ring
+        seed = jax.random.randint(ctx.rng(), (), 0, 2**31 - 1, jnp.int32)
+        return {"Out": [_ring.ring_attention(
+            q, k, v, bias, float(scale), float(dropout), causal, seed, gm)]}
 
     bias_shape = None if bias is None else bias.shape
     if impl == "pallas" and not supports_pallas(B, H, S, D, bias_shape,

@@ -21,6 +21,10 @@ from __future__ import annotations
 
 import functools
 
+# Incremented each time ring_attention is *traced*; tests and the driver dryrun
+# read it to assert the ring path (not GSPMD all-gather) is what actually ran.
+TRACE_COUNT = 0
+
 
 def _shard_map():
     try:
@@ -30,18 +34,31 @@ def _shard_map():
     return shard_map
 
 
-def _ring_local(q, k, v, bias, key, scale, dropout, causal, axis):
-    """Local computation: q/k/v [B,H,Sl,D] shards, bias [B,1,1,Sl] shard."""
+def _ring_local(q, k, v, bias, seed, scale, dropout, causal, axis,
+                vary_axes):
+    """Local computation: q/k/v [B,H,Sl,D] shards, bias [B,1,1,Sl] shard.
+
+    ``seed`` is a (1,) int32 array (raw PRNG seeds pass through shard_map on
+    every jax version; typed key arrays historically did not)."""
     import jax
     import jax.numpy as jnp
 
+    key = jax.random.PRNGKey(seed[0])
     n = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    m0 = jnp.full((B, H, Sq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    def varying(x):
+        # scan carries must enter with the same varying-over-mesh-axes type
+        # the body produces (jax vma typing for shard_map)
+        try:
+            return jax.lax.pcast(x, vary_axes, to="varying")
+        except AttributeError:
+            return jax.lax.pvary(x, vary_axes)
+
+    m0 = varying(jnp.full((B, H, Sq, 1), -jnp.inf, jnp.float32))
+    l0 = varying(jnp.zeros((B, H, Sq, 1), jnp.float32))
+    acc0 = varying(jnp.zeros((B, H, Sq, D), jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(carry, step):
@@ -81,29 +98,39 @@ def _ring_local(q, k, v, bias, key, scale, dropout, causal, axis):
     return (acc / l).astype(q.dtype)
 
 
-def ring_attention(q, k, v, bias, scale, dropout, causal, rng_key, mesh,
+def ring_attention(q, k, v, bias, scale, dropout, causal, seed, mesh,
                    seq_axis="sp", batch_axis="dp", head_axis="mp"):
     """softmax(QK^T*scale + bias)V with Q/K/V sequence-sharded over ``seq_axis``.
 
-    q/k/v: [B, H, S, D] global views; bias: [B, 1, 1, S] additive or None.
-    Opens a shard_map over ``mesh``; batch rides ``batch_axis`` and heads
-    ``head_axis`` when those axes exist, so no resharding is forced on them.
+    q/k/v: [B, H, S, D] global views; bias: [B, 1, 1, S] additive or None;
+    seed: scalar/(1,) int32 for attention dropout. Opens a shard_map over
+    ``mesh``; batch rides ``batch_axis`` and heads ``head_axis`` when those
+    axes exist and divide the dims, so no resharding is forced on them.
     """
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    def ax(name):
-        return name if mesh.shape.get(name, 1) > 1 else None
+    global TRACE_COUNT
+    TRACE_COUNT += 1
+    B, H, S, _ = q.shape
 
-    dp, mp, sp = ax(batch_axis), ax(head_axis), seq_axis
+    def ax(name, dim):
+        n = mesh.shape.get(name, 1)
+        return name if n > 1 and dim % n == 0 else None
+
+    dp, mp, sp = ax(batch_axis, B), ax(head_axis, H), seq_axis
+    if S % mesh.shape[sp] != 0:
+        raise ValueError(f"ring_attention: S={S} not divisible by "
+                         f"{sp}={mesh.shape[sp]}")
     if bias is None:
-        B, _, S, _ = q.shape
         bias = jnp.zeros((B, 1, 1, S), jnp.float32)
-    local = functools.partial(_ring_local, scale=scale, dropout=dropout,
-                              causal=causal, axis=sp)
+    seed = jnp.asarray(seed, jnp.int32).reshape(1)
+    local = functools.partial(
+        _ring_local, scale=scale, dropout=dropout, causal=causal, axis=sp,
+        vary_axes=tuple(a for a in (dp, mp, sp) if a is not None))
     f = _shard_map()(
         local, mesh=mesh,
         in_specs=(P(dp, mp, sp, None), P(dp, mp, sp, None),
                   P(dp, mp, sp, None), P(dp, None, None, sp), P()),
         out_specs=P(dp, mp, sp, None))
-    return f(q, k, v, bias, rng_key)
+    return f(q, k, v, bias, seed)
