@@ -217,6 +217,37 @@ class CompiledProgram:
             self._mesh = self.dist_strategy.build_mesh()
         return self._mesh
 
+    def state_sharding(self, name: str):
+        """The NamedSharding the executor compiles for persistable var ``name``
+        (None when no strategy). Single source of truth shared by the compile
+        path (core/executor.py:_compile) and checkpoint reshard-on-load
+        (io.py:load_vars) so a loaded array's sharding always matches what the
+        jitted step expects."""
+        ds = self.dist_strategy
+        if ds is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .framework import Parameter
+        mesh = self.mesh
+        v = self.program.global_block().find_var_recursive(name)
+        spec = ds.param_spec(name) if v is not None else P()
+        if v is not None and len(spec) > len(v.shape):
+            # a param rule matched a lower-rank derived var (e.g. Adam's
+            # beta_pow accumulator sharing the param's name prefix): replicate
+            spec = P()
+        bs = self.build_strategy
+        reduce_mode = (bs.reduce_strategy == BuildStrategy.ReduceStrategy.Reduce
+                       and "dp" in mesh.shape and mesh.shape["dp"] > 1)
+        if (reduce_mode and v is not None and spec == P()
+                and not isinstance(v, Parameter)):
+            # ZeRO-style accumulator sharding (details/reduce_op_handle.* analog)
+            ndp = mesh.shape["dp"]
+            for dim, s in enumerate(v.shape):
+                if isinstance(s, int) and s > 0 and s % ndp == 0:
+                    spec = P(*([None] * dim), "dp")
+                    break
+        return NamedSharding(mesh, spec)
+
     # Program-API passthroughs used by Executor
     def global_block(self):
         return self.program.global_block()

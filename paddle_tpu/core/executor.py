@@ -264,8 +264,15 @@ class Executor:
             # global batch -> make_array_from_process_local_data (the per-host
             # feed split of reference executor.py:618).
             def to_global(v, sh):
-                if hasattr(v, "sharding") and v.sharding == sh:
-                    return v
+                if hasattr(v, "sharding"):
+                    if v.sharding == sh:
+                        return v
+                    if not getattr(v, "is_fully_addressable", True):
+                        # global array with a different sharding (e.g. a
+                        # checkpoint loaded under another strategy): let XLA
+                        # transfer-reshard it rather than np.asarray (which
+                        # raises on non-addressable arrays)
+                        return jax.device_put(v, sh)
                 return jax.device_put(np.asarray(v), sh)
 
             mut_vals = {n: to_global(v, compiled.state_shardings[n])
@@ -382,40 +389,16 @@ class Executor:
             # SPMD path (the ParallelExecutor analog): jit over the strategy's mesh
             # with sharding constraints on state and feeds; XLA/GSPMD inserts the
             # ICI collectives the reference implemented as AllReduceOpHandles.
+            # Per-var shardings (incl. ZeRO accumulator sharding under
+            # ReduceStrategy.Reduce) come from wrapper.state_sharding -- shared
+            # with checkpoint reshard-on-load (io.py) so they always agree.
             from jax.sharding import NamedSharding, PartitionSpec as P
-            from ..framework import Parameter
             ds = wrapper.dist_strategy
             mesh = wrapper.mesh
             var_of = block.find_var_recursive
 
-            # ReduceStrategy.Reduce (reference details/build_strategy.h:58,
-            # reduce_op_handle.*): the reference shards *ownership* of each
-            # param's optimizer update across devices. The TPU analog is
-            # ZeRO-style optimizer-state sharding: accumulators (moments etc.)
-            # that would be replicated get partitioned over "dp" instead --
-            # GSPMD gathers them where the update op needs them.
-            bs = getattr(wrapper, "build_strategy", None)
-            reduce_mode = (bs is not None and
-                           bs.reduce_strategy == type(bs).ReduceStrategy.Reduce
-                           and "dp" in mesh.shape and mesh.shape["dp"] > 1)
-
-            def zero_spec(shape):
-                ndp = mesh.shape["dp"]
-                for dim, s in enumerate(shape):
-                    if isinstance(s, int) and s > 0 and s % ndp == 0:
-                        return P(*([None] * dim), "dp")
-                return P()
-
             def state_sharding(names):
-                out = {}
-                for n in names:
-                    v = var_of(n)
-                    spec = ds.param_spec(n) if v is not None else P()
-                    if (reduce_mode and v is not None and spec == P()
-                            and not isinstance(v, Parameter)):
-                        spec = zero_spec(v.shape)
-                    out[n] = NamedSharding(mesh, spec)
-                return out
+                return {n: wrapper.state_sharding(n) for n in names}
 
             in_shardings = (
                 state_sharding(mut_names),

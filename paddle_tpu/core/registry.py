@@ -47,7 +47,7 @@ class LowerCtx:
     """
 
     def __init__(self, attrs: dict, base_key=None, salt: int = 0, block_runner=None,
-                 program=None, mesh=None, gspmd_mesh=None):
+                 program=None, mesh=None, gspmd_mesh=None, abstract=False):
         self.attrs = attrs
         self._base_key = base_key
         self._salt = salt
@@ -58,6 +58,10 @@ class LowerCtx:
         # shard_map): ops may open their own shard_map islands over it
         # (ring attention) but must NOT call axis primitives directly
         self.gspmd_mesh = gspmd_mesh
+        # True under eval_shape-based inference: the mesh/backend are unknown,
+        # so impl choices must not be validated and shape-equivalent fallbacks
+        # should be used (e.g. fused_attention lowers its composed path)
+        self.abstract = abstract
 
     def attr(self, name, default=None):
         return self.attrs.get(name, default)
@@ -201,7 +205,7 @@ def _generic_grad_lower(fwd: OpDef, ctx, ins):
 
     fwd_attrs = {k: v for k, v in ctx.attrs.items() if not k.startswith("__fwd_")}
     fwd_ctx = LowerCtx(fwd_attrs, ctx._base_key, ctx._salt, ctx.block_runner,
-                       ctx.program, ctx.mesh)
+                       ctx.program, ctx.mesh, gspmd_mesh=ctx.gspmd_mesh)
 
     def f(*diff_vals):
         full = {s: list(ins[s]) for s in fwd_in_slots}
@@ -332,7 +336,7 @@ def _eval_shape_infer(d: OpDef, op: Operator, block: Block):
             vals.append(jax.ShapeDtypeStruct(shape, dtype))
         ins_struct[slot] = vals
 
-    ctx = LowerCtx(op.attrs)
+    ctx = LowerCtx(op.attrs, abstract=True)
     try:
         outs = jax.eval_shape(lambda ins: d.lower(ctx, ins), ins_struct)
     except Exception as e:

@@ -4,13 +4,21 @@ Reference: python/paddle/fluid/io.py (save_params:259, save_persistables:509,
 load_params:730, load_persistables:787, save_inference_model:997,
 load_inference_model:1201).
 
-Format (TPU-native, not the reference's binary): one ``<name>.npy`` per var plus a
-``__model__.json`` Program for inference models. Sharded SPMD params are gathered to
-host on save; on load the next jitted run re-shards them per the active strategy
-(reshard-on-load, SURVEY.md §5.4). bfloat16 is stored as uint16 with a sidecar flag.
+Format (TPU-native, not the reference's binary): each var is stored as one or
+more ``.npy`` *chunks*, each covering an index region of the global array, plus
+a JSON manifest per process. Sharded SPMD arrays are saved without host
+gathering: every process writes only its unique (replica_id==0) addressable
+shards, so across processes the chunks tile each global array exactly once --
+the analog of the reference's ``_save_distributed_persistables``
+(python/paddle/fluid/io.py:328), minus the pserver hop. On load, chunks are
+stitched against the *target* sharding (``load_vars(main_program=<CompiledProgram>)``
+assembles per-device shards with ``jax.make_array_from_single_device_arrays``),
+so a dp8 checkpoint loads cleanly into a dp4xmp2 job (reshard-on-load,
+SURVEY.md §5.4). bfloat16 is stored as uint16 with a sidecar dtype tag.
 """
 from __future__ import annotations
 
+import glob as _glob
 import json
 import os
 from typing import List, Optional, Sequence
@@ -21,38 +29,193 @@ from .core.executor import Executor, Scope, global_scope
 from .framework import Parameter, Program, Variable, default_main_program
 
 
-def _to_numpy(val):
-    arr = np.asarray(val)
+def _storage_view(arr):
+    """np array -> (storable array, dtype tag); bf16 has no portable npy dtype."""
     if str(arr.dtype) == "bfloat16":
         return arr.view(np.uint16), "bfloat16"
     return arr, str(arr.dtype)
 
 
-def _save_var(dirname, name, val):
-    arr, dtype = _to_numpy(val)
-    path = os.path.join(dirname, name.replace("/", "__"))
-    np.save(path + ".npy", arr, allow_pickle=False)
-    return {"name": name, "dtype": dtype, "file": os.path.basename(path) + ".npy"}
-
-
-def _load_var(dirname, meta):
-    arr = np.load(os.path.join(dirname, meta["file"]), allow_pickle=False)
-    if meta["dtype"] == "bfloat16":
-        import jax.numpy as jnp
-        return jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
+def _restore_view(arr, dtype):
+    if dtype == "bfloat16":
+        import ml_dtypes
+        return arr.view(ml_dtypes.bfloat16)
     return arr
+
+
+def _storage_dtype(dtype):
+    if dtype == "bfloat16":
+        return np.uint16
+    return np.dtype(dtype)
+
+
+def _norm_index(idx, shape):
+    """jax shard .index (tuple of slices) -> [[start, stop], ...] over shape."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        out.append([int(sl.start or 0), int(dim if sl.stop is None else sl.stop)])
+    return out
+
+
+def _barrier():
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_io")
+
+
+def _is_sharded_array(val):
+    """True when val must be saved as per-shard chunks: a jax.Array that either
+    spans hosts or holds >1 distinct shard region (replicas don't count)."""
+    if not (hasattr(val, "addressable_shards") and hasattr(val, "sharding")):
+        return False
+    if not getattr(val, "is_fully_addressable", True):
+        return True
+    return len({tuple(map(tuple, _norm_index(s.index, val.shape)))
+                for s in val.addressable_shards}) > 1
+
+
+def _save_var(dirname, name, val, rank):
+    """Write var chunks owned by this process; return a manifest entry (or None
+    when this process owns nothing -- e.g. a replicated shard held elsewhere)."""
+    base = name.replace("/", "__")
+    if _is_sharded_array(val):
+        shape = tuple(val.shape)
+        dtype = None
+        chunks = []
+        seen = set()
+        for i, sh in enumerate(val.addressable_shards):
+            if sh.replica_id != 0:
+                continue
+            region = _norm_index(sh.index, shape)
+            key = tuple(map(tuple, region))
+            if key in seen:   # two local devices can hold the same region
+                continue
+            seen.add(key)
+            arr, dtype = _storage_view(np.asarray(sh.data))
+            fname = f"{base}.r{rank}c{i}.npy"
+            np.save(os.path.join(dirname, fname), arr, allow_pickle=False)
+            chunks.append({"file": fname, "index": region})
+        if not chunks:
+            return None
+        if dtype is None:
+            dtype = str(val.dtype)
+        return {"name": name, "dtype": dtype, "shape": list(shape),
+                "chunks": chunks}
+    # host value / single-device / fully-replicated: identical on all hosts,
+    # rank 0 writes the whole array as a single chunk
+    if rank != 0:
+        return None
+    arr, dtype = _storage_view(np.asarray(val))
+    fname = base + ".npy"
+    np.save(os.path.join(dirname, fname), arr, allow_pickle=False)
+    return {"name": name, "dtype": dtype, "shape": list(arr.shape),
+            "chunks": [{"file": fname,
+                        "index": [[0, s] for s in arr.shape]}]}
+
+
+def _stitch(dirname, meta, region):
+    """Assemble the [start, stop) region of a var from its chunk files."""
+    out = np.empty([b - a for a, b in region],
+                   dtype=_storage_dtype(meta["dtype"]))
+    covered = 0
+    for ch in meta["chunks"]:
+        cidx = ch["index"]
+        inter = [(max(a, ca), min(b, cb))
+                 for (a, b), (ca, cb) in zip(region, cidx)]
+        if any(lo >= hi for lo, hi in inter):
+            continue
+        src = np.load(os.path.join(dirname, ch["file"]), mmap_mode="r")
+        src_sl = tuple(slice(lo - ca, hi - ca)
+                       for (lo, hi), (ca, _) in zip(inter, cidx))
+        dst_sl = tuple(slice(lo - a, hi - a)
+                       for (lo, hi), (a, _) in zip(inter, region))
+        out[dst_sl] = src[src_sl]
+        covered += int(np.prod([hi - lo for lo, hi in inter] or [1]))
+    want = int(np.prod([b - a for a, b in region] or [1]))
+    if covered < want:
+        raise RuntimeError(
+            f"checkpoint chunks for {meta['name']!r} cover {covered} of {want} "
+            f"elements in region {region}; a rank's manifest/chunk files are "
+            f"missing from {dirname}")
+    return _restore_view(out, meta["dtype"])
+
+
+def _load_var(dirname, meta, sharding=None):
+    shape = tuple(meta["shape"])
+    if sharding is None:
+        return _stitch(dirname, meta, [[0, s] for s in shape])
+    # reshard-on-load: assemble only this process's shards of the target
+    # sharding. Replicas share one stitched host buffer (stitch each distinct
+    # region once, not once per device).
+    import jax
+    idx_map = sharding.addressable_devices_indices_map(shape)
+    pieces = {}
+    bufs = []
+    for dev, idx in idx_map.items():
+        region = _norm_index(idx, shape)
+        key = tuple(map(tuple, region))
+        if key not in pieces:
+            pieces[key] = _stitch(dirname, meta, region)
+        bufs.append(jax.device_put(pieces[key], dev))
+    return jax.make_array_from_single_device_arrays(shape, sharding, bufs)
+
+
+def _unwrap_program(main_program):
+    """Accept a Program or CompiledProgram; return (program, wrapper-or-None)."""
+    if main_program is None:
+        return default_main_program(), None
+    if isinstance(main_program, Program):
+        return main_program, None
+    return main_program.program, main_program   # CompiledProgram
+
+
+def _manifest_path(dirname, filename, rank):
+    base = filename or "__manifest__.json"
+    return os.path.join(dirname, base if rank == 0 else f"{base}.rank{rank}")
+
+
+def _read_manifests(dirname, filename):
+    base = os.path.join(dirname, filename or "__manifest__.json")
+    if not os.path.exists(base):
+        raise FileNotFoundError(f"no checkpoint manifest at {base}")
+    with open(base) as f:
+        head = json.load(f)
+    # nranks recorded at save time bounds which rank manifests belong to THIS
+    # checkpoint -- a stale .rankN from an earlier wider save in the same dir
+    # must not be merged (it would silently mix old chunk data into the load)
+    nranks = head.get("nranks", 1)
+    metas = {}
+    for r in range(nranks):
+        p = base if r == 0 else f"{base}.rank{r}"
+        if not os.path.exists(p):
+            raise FileNotFoundError(
+                f"checkpoint at {dirname} was saved by {nranks} processes but "
+                f"rank {r}'s manifest {p} is missing")
+        with open(p) as f:
+            doc = head if r == 0 else json.load(f)
+        for m in doc["vars"]:
+            if m["name"] in metas:
+                metas[m["name"]]["chunks"].extend(m["chunks"])
+            else:
+                metas[m["name"]] = dict(m)
+    return metas
 
 
 def save_vars(executor, dirname, main_program=None, vars: Optional[List] = None,
               predicate=None, filename=None):
-    """Reference io.py:save_vars. ``filename`` accepted for parity (single-file
-    format stores the manifest under that name)."""
-    main_program = main_program or default_main_program()
+    """Reference io.py:save_vars. Under multi-host each process writes its own
+    shard chunks + a rank manifest (no host gather); ``filename`` names the
+    manifest for single-file-format parity."""
+    import jax
+    main_program, _ = _unwrap_program(main_program)
     scope = global_scope()
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if (predicate is None or predicate(v))]
+    rank = jax.process_index()
     os.makedirs(dirname, exist_ok=True)
+    _barrier()   # every process must see the directory before writing
     manifest = []
     for v in vars:
         name = v.name if isinstance(v, Variable) else str(v)
@@ -60,9 +223,12 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[List] = None,
         if val is None:
             raise RuntimeError(f"variable {name!r} has no value in scope; "
                                f"run the startup program before saving")
-        manifest.append(_save_var(dirname, name, val))
-    with open(os.path.join(dirname, filename or "__manifest__.json"), "w") as f:
-        json.dump({"vars": manifest}, f)
+        entry = _save_var(dirname, name, val, rank)
+        if entry is not None:
+            manifest.append(entry)
+    with open(_manifest_path(dirname, filename, rank), "w") as f:
+        json.dump({"vars": manifest, "nranks": jax.process_count()}, f)
+    _barrier()   # checkpoint is complete only when every rank has written
 
 
 def _is_param(v):
@@ -88,10 +254,13 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None):
-    main_program = main_program or default_main_program()
+    """Reference io.py:load_vars. Pass a ``CompiledProgram`` as ``main_program``
+    to assemble each var directly against that strategy's shardings
+    (reshard-on-load): a checkpoint saved under dp8 loads into a dp4xmp2 job
+    with each process reading only the chunk regions its devices own."""
+    main_program, wrapper = _unwrap_program(main_program)
     scope = global_scope()
-    with open(os.path.join(dirname, filename or "__manifest__.json")) as f:
-        manifest = {m["name"]: m for m in json.load(f)["vars"]}
+    manifest = _read_manifests(dirname, filename)
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if (predicate is None or predicate(v))]
@@ -100,7 +269,10 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         if name not in manifest:
             raise RuntimeError(f"checkpoint at {dirname} has no variable "
                                f"{name!r}")
-        val = _load_var(dirname, manifest[name])
+        sharding = (wrapper.state_sharding(name)
+                    if wrapper is not None and wrapper.dist_strategy is not None
+                    else None)
+        val = _load_var(dirname, manifest[name], sharding)
         if isinstance(v, Variable) and v.shape:
             declared = tuple(v.shape)
             mismatch = (len(val.shape) != len(declared) or
@@ -164,9 +336,6 @@ def load_inference_model(dirname, executor, model_filename=None,
         model = json.load(f)
     program = Program.from_dict(model["program"])
     scope = global_scope()
-    with open(os.path.join(dirname, params_filename or
-                           "__manifest__.json")) as f:
-        manifest = json.load(f)["vars"]
-    for m in manifest:
+    for m in _read_manifests(dirname, params_filename).values():
         scope.set_var(m["name"], _load_var(dirname, m))
     return program, model["feed_names"], model["fetch_names"]

@@ -306,6 +306,13 @@ def fused_attention(ctx, ins):
     impl = ctx.attr("impl", "auto")
     is_tpu = jax.default_backend() == "tpu"
 
+    if ctx.abstract:
+        # eval_shape inference: mesh/backend are unknown here, and every impl
+        # produces the same output shape -- lower the composed path and defer
+        # impl validation to the executor's real lowering
+        return {"Out": [composed_attention(q, k, v, bias, float(scale), 0.0,
+                                           causal, ctx.rng())]}
+
     gm = ctx.gspmd_mesh
     sp_n = gm.shape.get("sp", 1) if gm is not None else 1
     ring_ok = sp_n > 1 and S % sp_n == 0 and (

@@ -60,6 +60,80 @@ def test_save_params_excludes_optimizer_state(tmp_path):
     assert not any("learning_rate" in n for n in names)
 
 
+def test_sharded_checkpoint_reshard_on_load(tmp_path):
+    """Save under dp8+ZeRO (optimizer state sharded over dp -> chunked files),
+    load into a dp4xmp2 job assembled against the *target* shardings, and
+    assert trajectory parity (VERDICT r2 #4; reference io.py:328
+    _save_distributed_persistables)."""
+    import json
+
+    import jax
+    d = str(tmp_path / "ckpt_shard")
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        startup.random_seed = 11
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [16], "float32")
+            label = fluid.data("label", [1], "int64")
+            h = fluid.layers.fc(x, 32, act="relu",
+                                param_attr=fluid.ParamAttr(name="rw1"))
+            logits = fluid.layers.fc(h, 8,
+                                     param_attr=fluid.ParamAttr(name="rw2"))
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        return main, startup, loss
+
+    def batches(n, seed=7):
+        rng = np.random.RandomState(seed)
+        return [(rng.randn(16, 16).astype("float32"),
+                 rng.randint(0, 8, (16, 1)).astype("int64")) for _ in range(n)]
+
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    main, startup, loss = build()
+    cp = fluid.CompiledProgram(main, build_strategy=bs) \
+        .with_data_parallel(loss_name=loss.name)
+    exe = fluid.Executor()
+    data = batches(5)
+    ref = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for x, y in data[:3]:
+            exe.run(cp, feed={"x": x, "label": y}, fetch_list=[loss])
+        fluid.io.save_persistables(exe, d, cp)
+        for x, y in data[3:]:
+            lv, = exe.run(cp, feed={"x": x, "label": y}, fetch_list=[loss])
+            ref.append(float(np.asarray(lv).reshape(())))
+
+    # the ZeRO-sharded moments must have been written as per-shard chunks
+    with open(os.path.join(d, "__manifest__.json")) as f:
+        manifest = json.load(f)["vars"]
+    assert any(len(m["chunks"]) > 1 for m in manifest), \
+        "expected at least one chunked (sharded) var in the checkpoint"
+
+    # fresh job with a different mesh: dp4 x mp2, tensor-parallel fc weights
+    main2, startup2, loss2 = build()
+    strat = fluid.DistributedStrategy(
+        mesh_shape={"dp": 4, "mp": 2},
+        param_rules=[("rw1", (None, "mp")), ("rw2", ("mp", None))])
+    cp2 = fluid.CompiledProgram(main2).with_strategy(strat)
+    got = []
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.io.load_persistables(exe, d, cp2)
+        w = fluid.global_scope().find_var("rw1")
+        # reshard-on-load: the loaded weight is already mp-partitioned
+        assert isinstance(w, jax.Array)
+        assert w.shape == (16, 32)
+        assert w.addressable_shards[0].data.shape == (16, 16)
+        for x, y in data[3:]:
+            lv, = exe.run(cp2, feed={"x": x, "label": y}, fetch_list=[loss2])
+            got.append(float(np.asarray(lv).reshape(())))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-5)
+
+
 def test_inference_model_roundtrip(tmp_path):
     d = str(tmp_path / "infer")
     with fluid.scope_guard(fluid.Scope()):

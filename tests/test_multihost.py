@@ -26,14 +26,15 @@ def _free_port():
     return port
 
 
-def _launch(nproc, port):
+def _launch(nproc, port, ckpt_dir=None):
     procs = []
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
+    extra = [str(ckpt_dir)] if ckpt_dir else []
     for r in range(nproc):
         procs.append(subprocess.Popen(
-            [sys.executable, _RUNNER, str(r), str(nproc), str(port)],
+            [sys.executable, _RUNNER, str(r), str(nproc), str(port)] + extra,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env))
     outs = []
     for p in procs:
@@ -59,6 +60,34 @@ def test_two_process_dp_matches_single_process():
     l0, l1 = _losses(outs[0]), _losses(outs[1])
     np.testing.assert_allclose(l0, l1, rtol=1e-6)   # ranks agree
     np.testing.assert_allclose(single, l0, rtol=2e-4, atol=1e-5)
+
+
+def _tagged(out, tag):
+    for line in out.splitlines():
+        if line.startswith(tag + ":"):
+            return json.loads(line[len(tag) + 1:])
+    raise AssertionError(f"no {tag} line in output: {out[-500:]}")
+
+
+def test_multihost_sharded_checkpoint_reshard(tmp_path):
+    """2-host dp8+ZeRO run saves per-host shard chunks; the same processes then
+    load the checkpoint into a dp4xmp2 mesh and continue -- the resumed
+    trajectory must match a single-process run of the identical schedule
+    (VERDICT r2 #4; reference io.py:328 _save_distributed_persistables)."""
+    single_dir = tmp_path / "ck_single"
+    multi_dir = tmp_path / "ck_multi"
+    single = _launch(1, _free_port(), single_dir)[0]
+    outs = _launch(2, _free_port(), multi_dir)
+    # both ranks agree, and multi == single for both phases
+    for tag in ("LOSSES", "CKPT_LOSSES"):
+        ref = _tagged(single, tag)
+        l0, l1 = _tagged(outs[0], tag), _tagged(outs[1], tag)
+        np.testing.assert_allclose(l0, l1, rtol=1e-6)
+        np.testing.assert_allclose(ref, l0, rtol=2e-4, atol=1e-5)
+    # the 2-host checkpoint must contain chunks written by *both* ranks
+    assert any(".r1c" in f.name for f in multi_dir.glob("*.npy")), \
+        "rank 1 wrote no shard chunks -- sharded save not exercised"
+    assert (multi_dir / "__manifest__.json.rank1").exists()
 
 
 def test_pipeline_spmd_matches_serial():

@@ -150,6 +150,22 @@ def test_program_no_sp_does_not_ring():
 
 
 def test_impl_ring_raises_without_sp_mesh():
-    # surfaces at program build (shape inference lowers the op with no mesh)
+    """Building with impl='ring' succeeds (shape inference can't know the
+    mesh); *running* without an sp>1 mesh raises at lowering time."""
+    main, startup, loss = _attn_program(23, impl="ring")
     with pytest.raises(Exception, match="ring"):
-        _attn_program(23, impl="ring")
+        _train(main, startup, loss, steps=1)
+
+
+def test_impl_ring_explicit_under_sp_mesh():
+    """impl='ring' (not just 'auto') is reachable and matches single-device."""
+    single = _train(*_attn_program(24))
+    main, startup, loss = _attn_program(24, impl="ring")
+    strat = fluid.DistributedStrategy(
+        mesh_shape={"dp": 2, "sp": 4},
+        data_rules=[("x", ("dp", "sp")), ("mask", ("dp", "sp"))])
+    cp = fluid.CompiledProgram(main).with_strategy(strat)
+    before = ring_mod.TRACE_COUNT
+    ring = _train(cp, startup, loss)
+    assert ring_mod.TRACE_COUNT > before
+    np.testing.assert_allclose(single, ring, rtol=2e-4, atol=1e-5)
