@@ -334,11 +334,23 @@ class Executor:
                     written.append(n)
                 produced.add(n)
         # Sub-blocks (scan/while bodies) read outer persistables too.
+        top_writes = set(written)
         for sub in program.blocks[1:]:
             for op in sub.ops:
                 for n in op.input_arg_names():
                     if n in persistable and n not in produced and n not in read:
                         read.append(n)
+                for n in op.output_arg_names():
+                    # A persistable written only inside a sub-block cannot
+                    # escape the functional lowering -- the write would be
+                    # silently lost. The DSL (While/Switch) lifts outer writes
+                    # into the op's Out list; hand-wired blocks must too.
+                    if n in persistable and n not in top_writes:
+                        raise RuntimeError(
+                            f"persistable var {n!r} is written inside "
+                            f"sub-block {sub.idx} but the enclosing "
+                            f"control-flow op does not output it; add it to "
+                            f"the op's out_names/Out so the write persists")
         for n in fetch_names:
             if n in persistable and n not in produced and n not in read:
                 read.append(n)

@@ -18,7 +18,6 @@ SURVEY.md §5.4). bfloat16 is stored as uint16 with a sidecar dtype tag.
 """
 from __future__ import annotations
 
-import glob as _glob
 import json
 import os
 from typing import List, Optional, Sequence

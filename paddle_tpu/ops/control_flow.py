@@ -90,9 +90,12 @@ def scan_op(ctx, ins):
     """Structured recurrence: the TPU-native replacement for recurrent_op/DynamicRNN.
 
     attrs: sub_block, carry_names (loop state), x_names (per-step inputs scanned over
-    the time axis), out_names (per-step outputs stacked), time_major.
+    the time axis), out_names (per-step outputs stacked), static_names, time_major.
     Inputs: Init (initial carries, ordered as carry_names), X (sequences [T, ...] or
-    [B, T, ...]).
+    [B, T, ...]), Static (loop-invariant outer vars read by the body -- params,
+    lengths. They MUST be declared inputs, not closure-captured: the generic
+    grad is jax.vjp over this lowering's declared inputs, so a closure-captured
+    param would silently get no gradient).
     """
     import jax
     import jax.numpy as jnp
@@ -102,6 +105,7 @@ def scan_op(ctx, ins):
     x_names = list(ctx.attr("x_names", []))
     out_names = list(ctx.attr("out_names", []))
     time_major = ctx.attr("time_major", False)
+    statics = dict(zip(ctx.attr("static_names", []), ins.get("Static", [])))
 
     init = dict(zip(carry_names, ins["Init"]))
     seqs = ins.get("X", [])
@@ -110,7 +114,8 @@ def scan_op(ctx, ins):
         seq_env[n] = s if time_major else jnp.swapaxes(s, 0, 1)
 
     def body(carry, xt):
-        env = dict(carry)
+        env = dict(statics)
+        env.update(carry)
         env.update(xt)
         env = ctx.block_runner(sub_idx, env)
         new_carry = {k: env[k] for k in carry_names}
@@ -148,6 +153,33 @@ def remat_segment(ctx, ins):
 
     outs = jax.checkpoint(f)(list(ins["X"]))
     return {"Out": list(outs)}
+
+
+@register("array_write", nondiff_inputs=("I", "ALen"))
+def array_write_op(ctx, ins):
+    """TensorArray write (reference lod_array_ops/array_write). TPU-native: the
+    array is a fixed-capacity stacked buffer [cap, *elem]; write is a
+    dynamic_update_slice at index i (differentiable wrt Array and X, so arrays
+    built inside a bounded While train end-to-end)."""
+    import jax
+    import jax.numpy as jnp
+    arr, x, i = ins["Array"][0], ins["X"][0], ins["I"][0]
+    alen = ins["ALen"][0]
+    idx = i.reshape(()).astype(jnp.int32)
+    new = jax.lax.dynamic_update_slice_in_dim(arr, x[None], idx, axis=0)
+    newlen = jnp.maximum(alen, (idx + 1).astype(alen.dtype).reshape(alen.shape))
+    return {"Out": [new], "OutLen": [newlen]}
+
+
+@register("array_read", nondiff_inputs=("I",))
+def array_read_op(ctx, ins):
+    """TensorArray read: dynamic_index_in_dim at i (reference array_read op)."""
+    import jax
+    import jax.numpy as jnp
+    arr, i = ins["Array"][0], ins["I"][0]
+    idx = i.reshape(()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(arr, idx, axis=0,
+                                                 keepdims=False)]}
 
 
 @register("print", grad="auto")

@@ -1,0 +1,349 @@
+"""Control-flow DSL tests (reference test_while_op.py, test_switch.py,
+test_ifelse.py, test_dynrnn_*, test_lod_tensor_array*): While/Switch/IfElse/
+DynamicRNN classes + TensorArray, all lowering to lax control flow."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, feed, fetches, startup=None):
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        if startup is not None:
+            exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+def test_while_dsl_forward():
+    """Reference-shaped While: body mutates outer vars in place; after the
+    loop their names hold the final values."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        i = layers.fill_constant([1], "float32", 0)
+        limit = layers.fill_constant([1], "float32", 3)
+        acc = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 1.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            t = layers.elementwise_mul(acc, x)
+            layers.assign(t, acc)
+            layers.increment(i, in_place=True)
+            layers.less_than(i, limit, cond=cond)
+    xv = np.array([[1.0, 2.0, 0.5, 3.0]], "float32")
+    accv, iv = _run(main, {"x": xv}, [acc, i])
+    np.testing.assert_allclose(accv, xv ** 3, rtol=1e-6)
+    assert float(iv[0]) == 3.0
+
+
+def test_while_dsl_gradient_with_max_iters():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        x.stop_gradient = False
+        i = layers.fill_constant([1], "float32", 0)
+        limit = layers.fill_constant([1], "float32", 3)
+        acc = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 1.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond, max_iters=5)
+        with w.block():
+            layers.assign(layers.elementwise_mul(acc, x), acc)
+            layers.increment(i, in_place=True)
+            layers.less_than(i, limit, cond=cond)
+        loss = layers.reduce_sum(acc)
+        grads = fluid.gradients(loss, [x])
+    xv = np.array([[1.0, 2.0, 0.5, 3.0]], "float32")
+    lv, gv = _run(main, {"x": xv}, [loss, grads[0]])
+    np.testing.assert_allclose(lv, np.sum(xv ** 3), rtol=1e-5)
+    np.testing.assert_allclose(gv, 3 * xv ** 2, rtol=1e-5)
+
+
+def test_while_requires_cond_rewrite():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0)
+        cond = layers.less_than(i, layers.fill_constant([1], "float32", 3))
+        w = layers.While(cond)
+        with pytest.raises(ValueError, match="rewrites the condition"):
+            with w.block():
+                layers.increment(i, in_place=True)
+
+
+def test_while_tensor_array_write_read_length():
+    """TensorArray inside a While (the MT-decode pattern): arr[i] = acc each
+    step; reads + length after the loop; gradient flows through the array."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        x.stop_gradient = False
+        arr = layers.create_array("float32", capacity=4)
+        i = layers.fill_constant([1], "float32", 0)
+        limit = layers.fill_constant([1], "float32", 3)
+        acc = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond, max_iters=4)
+        with w.block():
+            layers.assign(layers.elementwise_add(acc, x), acc)
+            layers.array_write(acc, i, array=arr)
+            layers.increment(i, in_place=True)
+            layers.less_than(i, limit, cond=cond)
+        idx = layers.fill_constant([1], "int32", 2)
+        last = layers.array_read(arr, idx)
+        n = layers.array_length(arr)
+        loss = layers.reduce_sum(last)
+        grads = fluid.gradients(loss, [x])
+    xv = np.array([[1.0, 2.0, 0.5, 3.0]], "float32")
+    lastv, nv, gv = _run(main, {"x": xv}, [last, n, grads[0]])
+    np.testing.assert_allclose(lastv, 3 * xv, rtol=1e-6)   # acc after 3 adds
+    assert int(nv[0]) == 3
+    np.testing.assert_allclose(gv, 3 * np.ones_like(xv), rtol=1e-6)
+
+
+def test_create_array_requires_capacity():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        arr = layers.create_array("float32")     # no capacity
+        i = layers.fill_constant([1], "int32", 0)
+        with pytest.raises(ValueError, match="capacity"):
+            layers.array_write(x, i, array=arr)
+
+
+def test_switch_first_match_wins():
+    """Piecewise-LR-style Switch: first true case fires; default covers the
+    rest; with no default and no match, the var keeps its prior value."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        s = fluid.data("s", [1], "float32")
+        sv = layers.reduce_mean(s)                 # scalar
+        lr = layers.fill_constant([1], "float32", 0.0)
+        b1 = layers.fill_constant([1], "float32", 5.0)
+        b2 = layers.fill_constant([1], "float32", 8.0)
+        c1 = layers.less_than(layers.reshape(sv, [1]), b1)
+        c2 = layers.less_than(layers.reshape(sv, [1]), b2)
+        with layers.Switch() as switch:
+            with switch.case(c1):
+                layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+            with switch.case(c2):
+                layers.assign(layers.fill_constant([1], "float32", 0.2), lr)
+            with switch.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.3), lr)
+    for feed_v, want in [(3.0, 0.1), (6.0, 0.2), (9.0, 0.3)]:
+        lv, = _run(main, {"s": np.full((1, 1), feed_v, "float32")}, [lr])
+        np.testing.assert_allclose(lv, [want], rtol=1e-6)
+
+
+def test_switch_no_match_keeps_value():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        s = fluid.data("s", [1], "float32")
+        sv = layers.reshape(layers.reduce_mean(s), [1])
+        lr = layers.fill_constant([1], "float32", 0.7)
+        c1 = layers.less_than(sv, layers.fill_constant([1], "float32", 0.0))
+        with layers.Switch() as switch:
+            with switch.case(c1):
+                layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+    lv, = _run(main, {"s": np.full((1, 1), 5.0, "float32")}, [lr])
+    np.testing.assert_allclose(lv, [0.7], rtol=1e-6)
+
+
+def test_ifelse_rowwise_merge_and_grad():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        x.stop_gradient = False
+        m = fluid.data("m", [1], "float32")        # 1.0 -> true rows
+        cond = layers.cast(m, "bool")              # [B, 1]
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(x), 1.0, bias=1.0))
+        with ie.false_block():
+            ie.output(layers.scale(ie.input(x), 2.0))
+        out, = ie()
+        loss = layers.reduce_sum(out)
+        grads = fluid.gradients(loss, [x])
+    xv = np.arange(12, dtype="float32").reshape(4, 3)
+    mv = np.array([[1.0], [0.0], [1.0], [0.0]], "float32")
+    ov, gv = _run(main, {"x": xv, "m": mv}, [out, grads[0]])
+    want = np.where(mv > 0, xv + 1, xv * 2)
+    np.testing.assert_allclose(ov, want, rtol=1e-6)
+    np.testing.assert_allclose(gv, np.where(mv > 0, 1.0, 2.0) *
+                               np.ones_like(xv), rtol=1e-6)
+
+
+def test_dynamic_rnn_masked_recurrence():
+    """h_t = h_{t-1} + x_t with per-row lengths: outputs zero past each
+    sequence's length and memories freeze (reference DynamicRNN semantics on
+    padded input)."""
+    B, T, D = 3, 5, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [T, D], "float32")       # [B, T, D]
+        lens = fluid.data("lens", [1], "int64")      # [B, 1]
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(x, lengths=lens)
+            prev = drnn.memory(shape=[D], value=0.0)
+            h = layers.elementwise_add(w, prev)
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, D).astype("float32")
+    lv = np.array([[2], [5], [3]], "int64")
+    ov, = _run(main, {"x": xv, "lens": lv}, [out])
+    want = np.zeros((B, T, D), "float32")
+    for b in range(B):
+        h = np.zeros(D, "float32")
+        for t in range(int(lv[b, 0])):
+            h = h + xv[b, t]
+            want[b, t] = h
+    np.testing.assert_allclose(ov, want, rtol=1e-5, atol=1e-6)
+
+
+def test_while_trains_params_in_body():
+    """The MT-book shape: an fc (parameter) inside the While body; minimize()
+    must route gradients through the loop to the param and the loss must drop."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    startup.random_seed = 9
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [8], "float32")
+        target = fluid.data("target", [8], "float32")
+        h = layers.fill_constant_batch_size_like(x, [-1, 8], "float32", 0.0)
+        i = layers.fill_constant([1], "float32", 0)
+        limit = layers.fill_constant([1], "float32", 3)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond, max_iters=3)
+        with w.block():
+            step = layers.fc(layers.elementwise_add(h, x), 8, act="tanh",
+                             param_attr=fluid.ParamAttr(name="loop_w"))
+            layers.assign(step, h)
+            layers.increment(i, in_place=True)
+            layers.less_than(i, limit, cond=cond)
+        loss = layers.reduce_mean(layers.square(
+            layers.elementwise_sub(h, target)))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(4, 8).astype("float32")
+    tv = rng.randn(4, 8).astype("float32") * 0.1
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(15):
+            lv, = exe.run(main, feed={"x": xv, "target": tv},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_scan_body_params_get_gradients():
+    """Params created/read inside a Scan/DynamicRNN body must receive grads
+    (they are declared Static inputs of the scan op, not closure captures --
+    a closure-captured param would silently never train)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    startup.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 6], "float32")          # [B, T, D]
+        lens = fluid.data("lens", [1], "int64")
+        target = fluid.data("target", [8], "float32")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(x, lengths=lens)
+            prev = drnn.memory(shape=[8], value=0.0)
+            h = layers.fc(layers.concat([w, prev], axis=1), 8, act="tanh",
+                          param_attr=fluid.ParamAttr(name="drnn_w"))
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()
+        last = out[:, 3]
+        loss = layers.reduce_mean(layers.square(
+            layers.elementwise_sub(last, target)))
+        _, pg = fluid.optimizer.Adam(0.05).minimize(loss)
+    assert any(p.name == "drnn_w" for p, _ in pg), \
+        f"body param got no gradient: {[(p.name) for p, _ in pg]}"
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.randn(5, 4, 6).astype("float32"),
+            "lens": np.full((5, 1), 4, "int64"),
+            "target": (rng.randn(5, 8) * 0.1).astype("float32")}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(20):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_gru_recurrence_weights_train():
+    """Regression for the closure-capture hole: simple_gru's own gate weights
+    (not just a readout) must appear in minimize()'s param-grad list."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        seq = fluid.data("seq", [5, 3], "float32")
+        h = fluid.layers.simple_gru(seq, 8)
+        loss = fluid.layers.mean(h)
+        _, pg = fluid.optimizer.SGD(0.1).minimize(loss)
+    got = {p.name for p, _ in pg}
+    from paddle_tpu.framework import Parameter
+    want = {v.name for v in main.global_block().vars.values()
+            if isinstance(v, Parameter)}
+    assert got == want, f"missing grads for {want - got}"
+
+
+def test_tensor_array_body_value_needs_like():
+    """First write of a body-computed dynamic-batch value: works with like=,
+    raises a clear error without it."""
+    def build(like):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [4], "float32")
+            arr = layers.create_array("float32", capacity=3,
+                                      like=x if like else None)
+            i = layers.fill_constant([1], "float32", 0)
+            limit = layers.fill_constant([1], "float32", 3)
+            cond = layers.less_than(i, limit)
+            w = layers.While(cond, max_iters=3)
+            with w.block():
+                t = layers.elementwise_add(x, x)   # body-computed, [-1, 4]
+                layers.array_write(t, i, array=arr)
+                layers.increment(i, in_place=True)
+                layers.less_than(i, limit, cond=cond)
+            r = layers.array_read(arr, layers.fill_constant([1], "int32", 1))
+        return main, r
+
+    with pytest.raises(ValueError, match="like"):
+        build(like=False)
+    main, r = build(like=True)
+    xv = np.ones((2, 4), "float32")
+    rv, = _run(main, {"x": xv}, [r])
+    np.testing.assert_allclose(rv, 2 * xv, rtol=1e-6)
+
+
+def test_subblock_persistable_write_must_escape():
+    """A persistable written inside a sub-block whose op doesn't output it is
+    a silent-loss bug -- the executor must refuse (VERDICT r2 weak #4)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        p = main.global_block().create_var("trap_p", (1,), "float32")
+        p.persistable = True
+        sub = main._create_block()
+        sub.append_op("fill_constant", outputs={"Out": ["trap_p"]},
+                      attrs={"shape": [1], "value": 1.0, "dtype": "float32"},
+                      infer_shape=False)
+        main._rollback()
+        c = layers.fill_constant([1], "bool", 1)
+        main.global_block().append_op(
+            "conditional_block", inputs={"Cond": [c.name], "X": []},
+            outputs={"Out": []},
+            attrs={"sub_block": sub.idx, "x_names": [], "out_names": []},
+            infer_shape=False)
+        y = layers.scale(x, 2.0)
+    with pytest.raises(RuntimeError, match="persistable.*sub-block"):
+        _run(main, {"x": np.ones((2, 4), "float32")}, [y])
