@@ -16,6 +16,7 @@ from .core.executor import Executor, Scope, global_scope, scope_guard  # noqa
 from .core.backward import append_backward, gradients, calc_gradient  # noqa
 from .core import registry  # noqa: F401
 from . import layers  # noqa: F401
+from . import nets  # noqa: F401
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

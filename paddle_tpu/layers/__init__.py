@@ -14,4 +14,33 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
 from .detection import *     # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .rnn import *           # noqa: F401,F403
+from .extras import (maxout, lrn, pixel_shuffle, shuffle_channel,  # noqa
+                     space_to_depth, temporal_shift, unfold, affine_channel,
+                     bilinear_tensor_product, add_position_encoding,
+                     multiplex, crop, crop_tensor, pad_constant_like,
+                     shard_index, fsp_matrix, row_conv,
+                     uniform_random_batch_size_like,
+                     gaussian_random_batch_size_like, selu, mean_iou,
+                     rank_loss, margin_rank_loss, bpr_loss, kldiv_loss,
+                     mse_loss, dice_loss, npair_loss,
+                     sampled_softmax_with_cross_entropy, nce, hsigmoid,
+                     warpctc, ctc_greedy_decoder, linear_chain_crf,
+                     crf_decoding, edit_distance, sampling_id, gather_tree,
+                     size, rank, autoincreased_step_counter, dynamic_lstm,
+                     dynamic_gru, dynamic_lstmp, lstm,
+                     logical_and, logical_or, logical_xor, logical_not, sum,
+                     strided_slice, scatter_nd, scatter_nd_add, expand_as,
+                     im2sequence, hash, lod_reset, lod_append,
+                     get_tensor_from_selected_rows, merge_selected_rows,
+                     continuous_value_model, py_func, conv3d, conv3d_transpose,
+                     pool3d, adaptive_pool3d, resize_trilinear,
+                     image_resize_short, spectral_norm, data_norm, center_loss,
+                     affine_grid, grid_sampler, random_crop, unique,
+                     unique_with_counts, teacher_student_sigmoid_loss)
+from .sequence import (sequence_pool, sequence_first_step,  # noqa
+                       sequence_last_step, sequence_softmax, sequence_reverse,
+                       sequence_concat, sequence_expand, sequence_expand_as,
+                       sequence_conv, sequence_pad, sequence_unpad,
+                       sequence_slice, sequence_enumerate, sequence_erase,
+                       sequence_reshape, sequence_scatter)
 from . import collective     # noqa: F401

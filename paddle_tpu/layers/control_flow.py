@@ -368,25 +368,36 @@ class Switch:
             for n in _outer_writes(prog, b.idx, parent):
                 if n not in outs:
                     outs.append(n)
+        # Nested levels only see declared inputs (the executor's block_runner
+        # merges the TOP-level env, not an enclosing loop body's), so every
+        # deeper case condition and every var any branch reads must ride the
+        # X slot -- otherwise a Switch inside a While body can't resolve them.
+        xs = list(outs)
+        for cond, _ in self._cases[1:]:
+            if cond.name not in xs:
+                xs.append(cond.name)
+        for b in branches:
+            for n in _outer_reads(prog, b.idx, parent, exclude=xs):
+                xs.append(n)
         next_else = self._default.idx if self._default is not None else -1
         for cond, blk in reversed(self._cases[1:]):
             wrapper = prog._create_block(parent_idx=parent.idx)
             wrapper.append_op(
                 "conditional_block",
-                inputs={"Cond": [cond.name], "X": list(outs)},
+                inputs={"Cond": [cond.name], "X": list(xs)},
                 outputs={"Out": list(outs)},
                 attrs={"sub_block": blk.idx, "else_block": next_else,
-                       "x_names": list(outs), "out_names": list(outs)},
+                       "x_names": list(xs), "out_names": list(outs)},
                 infer_shape=False)
             prog._rollback()
             next_else = wrapper.idx
         cond0, blk0 = self._cases[0]
         parent.append_op(
             "conditional_block",
-            inputs={"Cond": [cond0.name], "X": list(outs)},
+            inputs={"Cond": [cond0.name], "X": list(xs)},
             outputs={"Out": list(outs)},
             attrs={"sub_block": blk0.idx, "else_block": next_else,
-                   "x_names": list(outs), "out_names": list(outs)},
+                   "x_names": list(xs), "out_names": list(outs)},
             infer_shape=False)
 
 

@@ -48,8 +48,9 @@ def gru_unit(x_t, hidden_prev, param_attr=None, bias_attr=None):
 
 
 def simple_lstm(x, hidden_size, h0=None, c0=None, param_attr=None,
-                bias_attr=None, forget_bias=1.0):
-    """Full-sequence LSTM over padded [B, T, D] input via Scan -> lax.scan."""
+                bias_attr=None, forget_bias=1.0, return_cell=False):
+    """Full-sequence LSTM over padded [B, T, D] input via Scan -> lax.scan.
+    With ``return_cell`` returns (hidden_seq, cell_seq)."""
     from .control_flow import Scan
     B = x.shape[0]
     if h0 is None:
@@ -67,7 +68,10 @@ def simple_lstm(x, hidden_size, h0=None, c0=None, param_attr=None,
         scan.update_memory(h_prev, h)
         scan.update_memory(c_prev, c)
         scan.step_output(h)
-    return scan()
+        if return_cell:
+            scan.step_output(c)
+    out = scan()
+    return tuple(out) if return_cell else out
 
 
 def simple_gru(x, hidden_size, h0=None, param_attr=None, bias_attr=None):

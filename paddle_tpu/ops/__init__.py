@@ -22,3 +22,6 @@ from . import collective     # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import beam_ops       # noqa: F401
 from . import pallas_attention  # noqa: F401
+from . import extra_ops      # noqa: F401
+from . import ctc_crf_ops    # noqa: F401
+from . import sampled_ops    # noqa: F401

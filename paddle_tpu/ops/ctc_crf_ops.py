@@ -1,0 +1,183 @@
+"""CTC + linear-chain CRF ops in pure JAX.
+
+Reference: paddle/fluid/operators/warpctc_op.* (wraps the external warp-ctc
+CUDA library), ctc_align_op (greedy decode), linear_chain_crf_op.cc,
+crf_decoding_op.h. TPU-native: the forward/Viterbi recursions are lax.scan
+programs in log space -- no external kernel, reverse-mode differentiable by
+the registry's auto-vjp, and the ragged LoD inputs become padded [B, T, ...]
+plus explicit length vectors (SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register
+
+NEG = -1e30
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("warpctc", nondiff_inputs=("Label", "LogitsLength", "LabelLength"))
+def warpctc(ctx, ins):
+    """CTC loss, forward algorithm over the blank-interleaved label.
+
+    Logits [B, T, C] (unnormalized), Label [B, L] (padded), LogitsLength [B],
+    LabelLength [B]. attrs: blank (default 0), norm_by_times.
+    Loss [B, 1] = -log p(label | logits).
+    """
+    import jax
+    jnp = _jnp()
+    logits = ins["Logits"][0]
+    label = ins["Label"][0].astype("int32")
+    llen = ins["LogitsLength"][0].reshape(-1).astype("int32")
+    ylen = ins["LabelLength"][0].reshape(-1).astype("int32")
+    blank = int(ctx.attr("blank", 0))
+    B, T, C = logits.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+
+    logp = jax.nn.log_softmax(logits.astype("float32"), axis=-1)
+    # ext[s] = blank for even s, label[(s-1)//2] for odd s
+    ext = jnp.full((B, S), blank, "int32")
+    ext = ext.at[:, 1::2].set(label)
+    # skip transition s-2 -> s allowed when ext[s] != blank and != ext[s-2]
+    can_skip = jnp.concatenate(
+        [jnp.zeros((B, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+    emit = jnp.take_along_axis(          # [B, T, S] log p(ext[s] | t)
+        logp, jnp.broadcast_to(ext[:, None, :], (B, T, S)), axis=2)
+
+    alpha0 = jnp.full((B, S), NEG, "float32")
+    alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(ylen > 0, emit[:, 0, 1], NEG))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+        prev2 = jnp.where(can_skip, prev2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new = merged + emit[:, t]
+        return jnp.where((t < llen)[:, None], new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end = 2 * ylen                      # index of final blank
+    a_last = jnp.take_along_axis(alpha, end[:, None], axis=1)
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None],
+                                 axis=1)
+    loss = -jnp.logaddexp(a_last, jnp.where((ylen > 0)[:, None], a_prev, NEG))
+    if ctx.attr("norm_by_times", False):
+        loss = loss / jnp.maximum(llen[:, None].astype("float32"), 1.0)
+    return {"Loss": [loss.astype(logits.dtype)]}
+
+
+@register("ctc_align", grad=None, nondiff_inputs=("Input", "InputLength"))
+def ctc_align(ctx, ins):
+    """Greedy CTC decode (ctc_align_op): argmax per step, merge repeats, drop
+    blanks. Output stays padded [B, T] with attr padding_value beyond each
+    row's decoded length (+ OutLength [B])."""
+    jnp = _jnp()
+    probs = ins["Input"][0]              # [B, T, C]
+    ilen = ins["InputLength"][0].reshape(-1)
+    blank = int(ctx.attr("blank", 0))
+    pad = int(ctx.attr("padding_value", 0))
+    B, T = probs.shape[0], probs.shape[1]
+    ids = jnp.argmax(probs, axis=-1).astype("int32")          # [B, T]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, "int32"), ids[:, :-1]], 1)
+    valid = (jnp.arange(T)[None, :] < ilen[:, None])
+    keep = (ids != blank) & (ids != prev) & valid
+    # compact kept tokens to the front: stable sort by (not keep)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(ids, order, axis=1)
+    nkeep = jnp.sum(keep, axis=1)
+    out = jnp.where(jnp.arange(T)[None, :] < nkeep[:, None], compacted, pad)
+    return {"Output": [out], "OutputLength": [nkeep.astype("int64")]}
+
+
+def _crf_parts(transition):
+    start = transition[0]       # [N]
+    stop = transition[1]        # [N]
+    trans = transition[2:]      # [N, N] trans[i, j]: i -> j
+    return start, stop, trans
+
+
+@register("linear_chain_crf", nondiff_inputs=("Label", "Length"))
+def linear_chain_crf(ctx, ins):
+    """Negative log-likelihood of tag paths (linear_chain_crf_op.cc).
+
+    Emission [B, T, N]; Transition [N+2, N] (row 0 start, row 1 stop, rest
+    pairwise); Label [B, T]; Length [B]. LogLikelihood [B, 1] (negated cost,
+    matching the reference's output that callers negate into a loss).
+    """
+    import jax
+    jnp = _jnp()
+    em = ins["Emission"][0].astype("float32")
+    label = ins["Label"][0].astype("int32")
+    lens = ins["Length"][0].reshape(-1).astype("int32")
+    start, stop, trans = _crf_parts(ins["Transition"][0].astype("float32"))
+    B, T, N = em.shape
+
+    # numerator: score of the gold path
+    e_path = jnp.take_along_axis(em, label[:, :, None], axis=2)[..., 0]
+    t_mask = (jnp.arange(T)[None, :] < lens[:, None]).astype("float32")
+    gold = jnp.sum(e_path * t_mask, axis=1)
+    gold = gold + start[label[:, 0]]
+    pair = trans[label[:, :-1], label[:, 1:]]                  # [B, T-1]
+    gold = gold + jnp.sum(pair * t_mask[:, 1:], axis=1)
+    last = jnp.take_along_axis(label, jnp.maximum(lens - 1, 0)[:, None],
+                               axis=1)[:, 0]
+    gold = gold + stop[last]
+
+    # denominator: forward algorithm
+    a0 = start[None, :] + em[:, 0]                             # [B, N]
+
+    def step(a, t):
+        nxt = jax.scipy.special.logsumexp(
+            a[:, :, None] + trans[None, :, :], axis=1) + em[:, t]
+        return jnp.where((t < lens)[:, None], nxt, a), None
+
+    a, _ = jax.lax.scan(step, a0, jnp.arange(1, T))
+    logz = jax.scipy.special.logsumexp(a + stop[None, :], axis=1)
+    ll = (gold - logz)[:, None]
+    return {"LogLikelihood": [ll.astype(ins["Emission"][0].dtype)]}
+
+
+@register("crf_decoding", grad=None,
+          nondiff_inputs=("Emission", "Transition", "Length"))
+def crf_decoding(ctx, ins):
+    """Viterbi decode (crf_decoding_op.h): max-product forward + backtrace.
+    ViterbiPath [B, T] padded with 0 past each row's length."""
+    import jax
+    jnp = _jnp()
+    em = ins["Emission"][0].astype("float32")
+    lens = ins["Length"][0].reshape(-1).astype("int32")
+    start, stop, trans = _crf_parts(ins["Transition"][0].astype("float32"))
+    B, T, N = em.shape
+    a0 = start[None, :] + em[:, 0]
+
+    def fwd(a, t):
+        scores = a[:, :, None] + trans[None, :, :]             # [B, N, N]
+        best = jnp.max(scores, axis=1) + em[:, t]
+        bp = jnp.argmax(scores, axis=1).astype("int32")
+        active = (t < lens)[:, None]
+        return jnp.where(active, best, a), jnp.where(active, bp, -1)
+
+    a, bps = jax.lax.scan(fwd, a0, jnp.arange(1, T))           # bps [T-1,B,N]
+    # add stop score at each row's last step
+    last_tag = jnp.argmax(a + stop[None, :], axis=1).astype("int32")
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        prev = jnp.where(prev < 0, tag, prev)   # inactive steps: stay
+        return prev, tag
+
+    # scan emits [tag_{T-1}, ..., tag_1] and carries out tag_0
+    tag0, rev = jax.lax.scan(back, last_tag, bps[::-1])
+    path = jnp.concatenate([tag0[:, None], rev[::-1].T], axis=1)
+    # rows decoded right-aligned to length: mask the pad tail
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    return {"ViterbiPath": [jnp.where(valid, path, 0).astype("int64")]}

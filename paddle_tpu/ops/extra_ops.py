@@ -1,0 +1,602 @@
+"""Misc layer-op lowerings: vision rearranges, ranking/distill losses, random
+batch-like fills, beam backtrace.
+
+Reference: paddle/fluid/operators/ (maxout_op, lrn_op, multiplex_op,
+pixel_shuffle_op, shuffle_channel_op, space_to_depth_op, temporal_shift_op,
+unfold_op, affine_channel_op, bilinear_tensor_product_op,
+add_position_encoding_op, mean_iou_op, crop_tensor_op, pad_constant_like_op,
+shard_index_op, rank_loss_op, margin_rank_loss_op, bpr_loss_op, npair_loss (in
+python), kldiv_loss_op, sampling_id_op, gather_tree_op, fsp_op, row_conv_op,
+edit_distance_op, *_random_batch_size_like ops). Each is a direct jnp/lax
+lowering -- the reference's CPU/GPU kernel pairs and hand-written grads
+collapse into XLA + auto-vjp (core/registry.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register, simple_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("maxout")
+def maxout(ctx, ins):
+    """[B, C, H, W] -> max over `groups` consecutive channels."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    g = int(ctx.attr("groups"))
+    axis = int(ctx.attr("axis", 1))
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // g, g]
+    return {"Out": [jnp.max(x.reshape(shape), axis=axis + 1)]}
+
+
+@register("lrn")
+def lrn(ctx, ins):
+    """Local response normalization across channels (lrn_op.cc)."""
+    jnp = _jnp()
+    x = ins["X"][0]                       # [B, C, H, W]
+    n = int(ctx.attr("n", 5))
+    k = float(ctx.attr("k", 1.0))
+    alpha = float(ctx.attr("alpha", 1e-4))
+    beta = float(ctx.attr("beta", 0.75))
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    return {"Out": [x / jnp.power(k + alpha * acc, beta)]}
+
+
+@register("multiplex", nondiff_inputs=("Ids",))
+def multiplex(ctx, ins):
+    """out[b] = X[ids[b]][b] -- per-row selection among candidate tensors."""
+    jnp = _jnp()
+    ids = ins["Ids"][0].reshape(-1).astype("int32")
+    stacked = jnp.stack(ins["X"], axis=0)          # [K, B, ...]
+    return {"Out": [stacked[ids, jnp.arange(ids.shape[0])]]}
+
+
+@register("pixel_shuffle")
+def pixel_shuffle(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    r = int(ctx.attr("upscale_factor"))
+    b, c, h, w = x.shape
+    out = x.reshape(b, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": [out.reshape(b, c // (r * r), h * r, w * r)]}
+
+
+@register("shuffle_channel")
+def shuffle_channel(ctx, ins):
+    x = ins["X"][0]
+    g = int(ctx.attr("group"))
+    b, c, h, w = x.shape
+    return {"Out": [x.reshape(b, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+                    .reshape(b, c, h, w)]}
+
+
+@register("space_to_depth")
+def space_to_depth(ctx, ins):
+    x = ins["X"][0]
+    s = int(ctx.attr("blocksize"))
+    b, c, h, w = x.shape
+    out = x.reshape(b, c, h // s, s, w // s, s)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [out.reshape(b, c * s * s, h // s, w // s)]}
+
+
+@register("temporal_shift")
+def temporal_shift(ctx, ins):
+    """[N*T, C, H, W]: shift 1/4 channels one step back/forward in time."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    t = int(ctx.attr("seg_num"))
+    ratio = float(ctx.attr("shift_ratio", 0.25))
+    nt, c, h, w = x.shape
+    n = nt // t
+    v = x.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+    fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]),
+                           v[:, :-1, c1:c2]], 1)
+    out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+    return {"Out": [out.reshape(nt, c, h, w)]}
+
+
+@register("unfold")
+def unfold(ctx, ins):
+    """im2col (unfold_op.cc): [B, C, H, W] -> [B, C*kh*kw, L]."""
+    import jax
+    x = ins["X"][0]
+    kh, kw = ctx.attr("kernel_sizes")
+    sh, sw = ctx.attr("strides", [1, 1])
+    ph, pw = ctx.attr("paddings", [0, 0])[:2]
+    dh, dw = ctx.attr("dilations", [1, 1])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)], rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    b, ckk, oh, ow = patches.shape
+    return {"Out": [patches.reshape(b, ckk, oh * ow)]}
+
+
+@register("affine_channel")
+def affine_channel(ctx, ins):
+    x, scale, bias = ins["X"][0], ins["Scale"][0], ins["Bias"][0]
+    shape = (1, -1) + (1,) * (x.ndim - 2)   # NCHW
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins):
+    """out[b,k] = x[b] @ W[k] @ y[b] + bias[k] (MXU-friendly einsum)."""
+    jnp = _jnp()
+    x, w, y = ins["X"][0], ins["Weight"][0], ins["Y"][0]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register("add_position_encoding")
+def add_position_encoding(ctx, ins):
+    """out = alpha*x + beta*sinusoid_pe, x: [B, T, D]."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    alpha = float(ctx.attr("alpha", 1.0))
+    beta = float(ctx.attr("beta", 1.0))
+    _, t, d = x.shape
+    pos = np.arange(t)[:, None]
+    i = np.arange(d // 2)[None, :]
+    angle = pos / np.power(10000.0, 2 * i / d)
+    pe = np.zeros((t, d), "float32")
+    pe[:, 0::2] = np.sin(angle)
+    pe[:, 1::2] = np.cos(angle)
+    return {"Out": [alpha * x + beta * jnp.asarray(pe, x.dtype)[None]]}
+
+
+@register("mean_iou", grad=None, nondiff_inputs=("Predictions", "Labels"))
+def mean_iou(ctx, ins):
+    jnp = _jnp()
+    pred = ins["Predictions"][0].reshape(-1)
+    label = ins["Labels"][0].reshape(-1)
+    n = int(ctx.attr("num_classes"))
+    inter = jnp.zeros((n,), "float32").at[
+        jnp.where(pred == label, pred, n - 1).astype("int32")].add(
+        (pred == label).astype("float32"))
+    p_cnt = jnp.zeros((n,), "float32").at[pred.astype("int32")].add(1.0)
+    l_cnt = jnp.zeros((n,), "float32").at[label.astype("int32")].add(1.0)
+    union = p_cnt + l_cnt - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-9), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype("float32")), 1.0)
+    return {"OutMeanIou": [miou.reshape(())],
+            "OutWrong": [(l_cnt - inter).astype("int32")],
+            "OutCorrect": [inter.astype("int32")]}
+
+
+@register("crop_tensor")
+def crop_tensor(ctx, ins):
+    import jax
+    x = ins["X"][0]
+    offsets = ctx.attr("offsets")
+    shape = ctx.attr("shape")
+    return {"Out": [jax.lax.dynamic_slice(x, [int(o) for o in offsets],
+                                          [int(s) for s in shape])]}
+
+
+def _pad_like_infer(op, block):
+    # out mirrors X; eval_shape would see the dyn-batch sentinel on Y and
+    # compute a bogus negative pad
+    xv = block.find_var_recursive(op.inputs["X"][0])
+    out = op.outputs["Out"][0]
+    v = block.find_var_recursive(out)
+    if v is None:
+        block.create_var(out, tuple(xv.shape), xv.dtype)
+    else:
+        v.shape = tuple(xv.shape)
+
+
+@register("pad_constant_like", infer_shape=_pad_like_infer)
+def pad_constant_like(ctx, ins):
+    """Pad Y up to X's (larger) shape with pad_value (pad_constant_like_op)."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    v = float(ctx.attr("pad_value", 0.0))
+    pads = [(0, int(a) - int(b)) for a, b in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=v)]}
+
+
+@register("rank_loss")
+def rank_loss(ctx, ins):
+    jnp = _jnp()
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    o = left - right
+    return {"Out": [jnp.logaddexp(0.0, o) - label * o]}
+
+
+@register("margin_rank_loss")
+def margin_rank_loss(ctx, ins):
+    jnp = _jnp()
+    label, left, right = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = float(ctx.attr("margin", 0.0))
+    return {"Out": [jnp.maximum(0.0, -label * (left - right) + margin)]}
+
+
+@register("bpr_loss", nondiff_inputs=("Label",))
+def bpr_loss(ctx, ins):
+    """Bayesian personalized ranking: -mean_j log sigmoid(x_y - x_j)."""
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]                                 # [B, C]
+    y = ins["Label"][0].reshape(-1).astype("int32")
+    B, C = x.shape
+    pos = jnp.take_along_axis(x, y[:, None], axis=1)
+    diff = jax.nn.log_sigmoid(pos - x)              # [B, C]
+    mask = 1.0 - jax.nn.one_hot(y, C, dtype=x.dtype)
+    return {"Out": [(-jnp.sum(diff * mask, 1, keepdims=True) /
+                     max(C - 1, 1))]}
+
+
+@register("kldiv_loss")
+def kldiv_loss(ctx, ins):
+    """x is log-prob; loss = target*(log(target) - x) (kldiv_loss_op)."""
+    jnp = _jnp()
+    x, t = ins["X"][0], ins["Target"][0]
+    loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-30)) - x), 0.0)
+    red = ctx.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss).reshape(())
+    elif red == "sum":
+        loss = jnp.sum(loss).reshape(())
+    elif red == "batchmean":
+        loss = (jnp.sum(loss) / loss.shape[0]).reshape(())
+    return {"Loss": [loss]}
+
+
+@register("sampling_id", grad=None, nondiff_inputs=("X",))
+def sampling_id(ctx, ins):
+    import jax
+    x = ins["X"][0]                                  # [B, C] probabilities
+    out = jax.random.categorical(ctx.rng(), _jnp().log(
+        _jnp().maximum(x, 1e-30)), axis=1)
+    return {"Out": [out.astype("int64")]}
+
+
+@register("gather_tree", grad=None, nondiff_inputs=("Ids", "Parents"))
+def gather_tree(ctx, ins):
+    """Beam-search backtrace (gather_tree_op.cu): ids/parents [T, B, K]."""
+    import jax
+    jnp = _jnp()
+    ids, parents = ins["Ids"][0], ins["Parents"][0]
+    T = ids.shape[0]
+
+    def step(beam, t):
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        nxt = jnp.take_along_axis(parents[t], beam, axis=1)
+        return nxt, tok
+
+    k0 = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=ids.dtype)[None, :],
+                          ids.shape[1:])
+    _, toks = jax.lax.scan(step, k0, jnp.arange(T - 1, -1, -1))
+    return {"Out": [toks[::-1]]}
+
+
+@register("fsp")
+def fsp(ctx, ins):
+    """Flow-of-solution-procedure matrix (fsp_op): [B,C1,HW]@[B,HW,C2]/HW."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    h, w = x.shape[2], x.shape[3]
+    return {"Out": [jnp.einsum("bchw,bdhw->bcd", x, y) / (h * w)]}
+
+
+@register("row_conv")
+def row_conv(ctx, ins):
+    """Lookahead row convolution (row_conv_op): out[b,t] = sum_k f[k]*x[b,t+k]."""
+    jnp = _jnp()
+    x, f = ins["X"][0], ins["Filter"][0]     # [B, T, D], [K, D]
+    K = f.shape[0]
+    T = x.shape[1]
+    pad = jnp.pad(x, [(0, 0), (0, K - 1), (0, 0)])
+    out = sum(pad[:, k:k + T] * f[k][None, None, :] for k in range(K))
+    return {"Out": [out]}
+
+
+@register("uniform_random_batch_size_like", grad=None)
+def uniform_random_batch_size_like(ctx, ins):
+    import jax
+    ref = ins["Input"][0]
+    shape = list(ctx.attr("shape"))
+    shape[int(ctx.attr("input_dim_idx", 0))] = \
+        ref.shape[int(ctx.attr("input_dim_idx", 0))]
+    lo, hi = float(ctx.attr("min", -1.0)), float(ctx.attr("max", 1.0))
+    out = jax.random.uniform(ctx.rng(), tuple(shape),
+                             np.dtype(ctx.attr("dtype", "float32")), lo, hi)
+    return {"Out": [out]}
+
+
+@register("gaussian_random_batch_size_like", grad=None)
+def gaussian_random_batch_size_like(ctx, ins):
+    import jax
+    ref = ins["Input"][0]
+    shape = list(ctx.attr("shape"))
+    shape[int(ctx.attr("input_dim_idx", 0))] = \
+        ref.shape[int(ctx.attr("input_dim_idx", 0))]
+    mean = float(ctx.attr("mean", 0.0))
+    std = float(ctx.attr("std", 1.0))
+    out = mean + std * jax.random.normal(
+        ctx.rng(), tuple(shape), np.dtype(ctx.attr("dtype", "float32")))
+    return {"Out": [out]}
+
+
+@register("edit_distance", grad=None, nondiff_inputs=("Hyps", "Refs",
+                                                      "HypsLength",
+                                                      "RefsLength"))
+def edit_distance(ctx, ins):
+    """Levenshtein distance on padded id sequences (edit_distance_op).
+
+    Hyps [B, T1], Refs [B, T2] + lengths; the ragged LoD input of the
+    reference becomes padded+lengths. DP row recursion via lax.scan."""
+    import jax
+    jnp = _jnp()
+    hyp, ref = ins["Hyps"][0], ins["Refs"][0]
+    hlen = ins["HypsLength"][0].reshape(-1)
+    rlen = ins["RefsLength"][0].reshape(-1)
+    B, T1 = hyp.shape
+    T2 = ref.shape[1]
+    BIG = jnp.asarray(1e9, "float32")
+    cols = jnp.arange(T2 + 1)
+    # row 0: j for j <= rlen else BIG-ish (still fine: masked later)
+    row0 = jnp.broadcast_to(cols.astype("float32"), (B, T2 + 1))
+
+    def step(prev, i):
+        # prev: [B, T2+1] distances for hyp prefix length i
+        hi = jnp.take_along_axis(hyp, jnp.minimum(i, T1 - 1)[None]
+                                 .repeat(B, 0)[:, None], axis=1)  # [B,1]
+        sub = (ref != hi).astype("float32")                        # [B, T2]
+        ins_del = jnp.minimum(prev[:, 1:] + 1.0, prev[:, :-1] + sub)
+
+        def body(carry, j):
+            # left-to-right dependency for deletion: d[j] = min(cand, d[j-1]+1)
+            d = jnp.minimum(ins_del[:, j], carry + 1.0)
+            return d, d
+
+        first = jnp.minimum(ins_del[:, 0], (i + 1).astype("float32") + 1.0)
+        _, rest = jax.lax.scan(body, first, jnp.arange(1, T2))
+        row = jnp.concatenate(
+            [(i + 1).astype("float32").reshape(1).repeat(B)[:, None],
+             first[:, None], rest.T], axis=1)
+        active = (i < hlen)[:, None]
+        row = jnp.where(active, row, prev)
+        return row, None
+
+    final, _ = jax.lax.scan(step, row0, jnp.arange(T1))
+    dist = jnp.take_along_axis(final, rlen[:, None], axis=1)      # [B,1]
+    if ctx.attr("normalized", True):
+        dist = dist / jnp.maximum(rlen[:, None].astype("float32"), 1.0)
+    seq_num = jnp.asarray(B, "int64").reshape(1)
+    return {"Out": [dist.astype("float32")], "SequenceNum": [seq_num]}
+
+
+@register("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(ctx, ins):
+    """CTR distillation loss (teacher_student_sigmoid_loss_op.h:43): label
+    encodes click z and teacher score z' as {-2, -1, [0, 2]}."""
+    jnp = _jnp()
+    x = ins["X"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(x.dtype)
+    ce0 = jnp.maximum(x, 0) + jnp.log1p(jnp.exp(-jnp.abs(x)))   # z = 0
+    ce1 = ce0 - x                                               # z = 1
+    soft = label - jnp.where(label < 1.0, 0.0, 1.0)             # z' in [0,1)
+    ces = ce0 + jnp.maximum(x, 0) - x * soft + \
+        jnp.log1p(jnp.exp(-jnp.abs(x)))                         # 0 <= lb < 1
+    ces1 = ce1 + jnp.maximum(x, 0) - x * soft + \
+        jnp.log1p(jnp.exp(-jnp.abs(x)))                         # lb >= 1
+    y = jnp.where(label < -1.0, ce0,
+                  jnp.where(label < 0.0, ce1,
+                            jnp.where(label < 1.0, ces, ces1)))
+    return {"Y": [y[:, None]]}
+
+
+@register("hash", grad=None, nondiff_inputs=("X",))
+def hash_op(ctx, ins):
+    """Modular multiplicative hash of id windows (hash_op.h analog; the
+    reference uses xxhash -- any fixed mixer serves the embedding-bucket use)."""
+    jnp = _jnp()
+    x = ins["X"][0].astype("uint32")
+    num_hash = int(ctx.attr("num_hash", 1))
+    mod = int(ctx.attr("mod_by", 100000007))
+    outs = []
+    for i in range(num_hash):
+        h = (x * jnp.uint32((2654435761 + 40503 * i) & 0xFFFFFFFF) +
+             jnp.uint32((0x9E3779B9 * (i + 1)) & 0xFFFFFFFF))
+        h = h ^ (h >> 16)
+        outs.append((h % jnp.uint32(mod)).astype("int64"))
+    return {"Out": [jnp.stack(outs, axis=-1)]}
+
+
+@register("unique_with_counts", grad=None, nondiff_inputs=("X",))
+def unique_with_counts(ctx, ins):
+    """unique_with_counts_op: XLA needs static shapes, so Out is padded to
+    len(X) (tail filled with the last unique value) + UniqueCount scalar."""
+    jnp = _jnp()
+    x = ins["X"][0].reshape(-1)
+    out, idx, counts = jnp.unique(x, return_inverse=True, return_counts=True,
+                                  size=x.shape[0], fill_value=None)
+    n = jnp.sum(counts > 0)
+    return {"Out": [out], "Index": [idx.astype("int32")],
+            "Count": [counts.astype("int32")],
+            "UniqueCount": [n.astype("int32").reshape(1)]}
+
+
+@register("random_crop", grad=None, nondiff_inputs=("X", "Seed"))
+def random_crop(ctx, ins):
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]
+    shape = [int(s) for s in ctx.attr("shape")]
+    nbatch = x.ndim - len(shape)
+    keys = jax.random.split(ctx.rng(), len(shape))
+    starts = [0] * nbatch + [
+        jax.random.randint(keys[i], (), 0, x.shape[nbatch + i] - s + 1)
+        for i, s in enumerate(shape)]
+    out = jax.lax.dynamic_slice(x, starts, list(x.shape[:nbatch]) + shape)
+    return {"Out": [out]}
+
+
+@register("spectral_norm")
+def spectral_norm(ctx, ins):
+    """Weight / sigma_max(W) via power iteration (spectral_norm_op.h). U/V
+    come in as persistable state and leave updated -- the reference mutates
+    them in place; here they round-trip functionally."""
+    jnp = _jnp()
+    w, u, v = ins["Weight"][0], ins["U"][0], ins["V"][0]
+    dim = int(ctx.attr("dim", 0))
+    iters = int(ctx.attr("power_iters", 1))
+    eps = float(ctx.attr("eps", 1e-12))
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)   # [H, W]
+
+    def l2n(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    for _ in range(iters):
+        v = l2n(mat.T @ u)
+        u = l2n(mat @ v)
+    import jax
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ (mat @ v)
+    return {"Out": [w / sigma], "UOut": [u], "VOut": [v]}
+
+
+@register("center_loss", nondiff_inputs=("Label", "CenterUpdateRate"))
+def center_loss(ctx, ins):
+    """center_loss_op: pull features toward per-class centers; centers are
+    persistable state updated in-graph (functional round-trip)."""
+    jnp = _jnp()
+    x = ins["X"][0]                              # [B, D]
+    label = ins["Label"][0].reshape(-1).astype("int32")
+    centers = ins["Centers"][0]                  # [C, D]
+    alpha = ins["CenterUpdateRate"][0].reshape(())
+    c = centers[label]                           # [B, D]
+    diff = x - c
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if ctx.attr("need_update", True):
+        import jax
+        d = jax.lax.stop_gradient(diff)
+        cnt = jnp.zeros((centers.shape[0], 1), x.dtype).at[label].add(1.0)
+        upd = jnp.zeros_like(centers).at[label].add(d)
+        centers = centers + alpha * upd / (1.0 + cnt)
+    return {"Loss": [loss], "SampleCenterDiff": [diff],
+            "CentersOut": [centers]}
+
+
+@register("affine_grid")
+def affine_grid(ctx, ins):
+    """affine_grid_op: theta [B, 2, 3] -> sampling grid [B, H, W, 2]."""
+    jnp = _jnp()
+    theta = ins["Theta"][0]
+    n, c, h, w = ctx.attr("output_shape")
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                 # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)   # [H*W, 3]
+    out = jnp.einsum("bij,pj->bpi", theta.astype("float32"),
+                     base.astype("float32"))
+    return {"Output": [out.reshape(theta.shape[0], h, w, 2)
+                       .astype(theta.dtype)]}
+
+
+@register("grid_sampler")
+def grid_sampler(ctx, ins):
+    """grid_sampler_op: bilinear sample x at grid locations ([-1,1] normed)."""
+    jnp = _jnp()
+    x, grid = ins["X"][0], ins["Grid"][0]        # [B,C,H,W], [B,H',W',2]
+    B, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.clip(jnp.floor(gx), 0, W - 1)
+    y0 = jnp.clip(jnp.floor(gy), 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    wx = gx - x0
+    wy = gy - y0
+    Hp, Wp = grid.shape[1], grid.shape[2]
+    flat = x.reshape(B, C, H * W)
+
+    def at(yy, xx):
+        idx = (yy * W + xx).astype("int32").reshape(B, 1, Hp * Wp)
+        g = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (B, C, Hp * Wp)),
+                                axis=2)
+        return g.reshape(B, C, Hp, Wp)
+
+    def wgt(w2d):
+        return w2d[:, None, :, :]
+
+    val = (at(y0, x0) * wgt((1 - wx) * (1 - wy)) +
+           at(y0, x1) * wgt(wx * (1 - wy)) +
+           at(y1, x0) * wgt((1 - wx) * wy) +
+           at(y1, x1) * wgt(wx * wy))
+    return {"Output": [val]}
+
+
+@register("data_norm")
+def data_norm(ctx, ins):
+    """data_norm_op: normalization by accumulated batch statistics (CTR
+    models); the size/sum/square-sum accumulators round-trip as state."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    eps = float(ctx.attr("epsilon", 1e-4))
+    mean = bsum / bsize
+    scale = jnp.sqrt(bsize / (bsq - bsum * mean + eps))
+    y = (x - mean[None, :]) * scale[None, :]
+    import jax
+    xs = jax.lax.stop_gradient(x)
+    return {"Y": [y], "Means": [mean], "Scales": [scale],
+            "BatchSizeOut": [bsize + x.shape[0]],
+            "BatchSumOut": [bsum + jnp.sum(xs, 0)],
+            "BatchSquareSumOut": [bsq + jnp.sum(jnp.square(xs), 0)]}
+
+
+@register("py_func", grad=None)
+def py_func_op(ctx, ins):
+    """Host-callback op (reference py_func_op.cc): runs the registered python
+    callable outside XLA via jax.pure_callback. Shapes must be static."""
+    import jax
+    import jax.numpy as jnp
+    from ..layers.extras import _PYFUNC_TABLE
+    func = _PYFUNC_TABLE[int(ctx.attr("func_key"))]
+    dtypes = ctx.attr("out_dtypes")
+    # a -1 (batch) dim takes the first input's concrete dim at the same axis
+    ref = ins["X"][0]
+    shapes = [[ref.shape[i] if d == -1 and i < ref.ndim else d
+               for i, d in enumerate(s)] for s in ctx.attr("out_shapes")]
+    if any(-1 in s for s in shapes):
+        raise ValueError("py_func outputs need static shapes on TPU (only a "
+                         "leading batch dim may be -1)")
+
+    def host(*args):
+        r = func(*args)
+        if not isinstance(r, (list, tuple)):
+            r = (r,)
+        return tuple(np.asarray(v).astype(d) for v, d in zip(r, dtypes))
+
+    structs = tuple(
+        jax.ShapeDtypeStruct(tuple(s), jnp.bfloat16 if d == "bfloat16"
+                             else np.dtype(d))
+        for s, d in zip(shapes, dtypes))
+    outs = jax.pure_callback(host, structs, *ins["X"])
+    return {"Out": list(outs)}

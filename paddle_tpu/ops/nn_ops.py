@@ -53,6 +53,18 @@ register("conv2d")(lambda ctx, ins: _conv(ctx, ins))
 register("depthwise_conv2d")(lambda ctx, ins: _conv(ctx, ins, depthwise=True))
 
 
+def _grouped_conv_transpose(x, w, groups, conv1):
+    """lax.conv_transpose has no feature_group_count: split channels, conv
+    each group, concat outputs. w: [in_c, out_c/groups, ...]."""
+    import jax.numpy as jnp
+    if groups <= 1:
+        return conv1(x, w)
+    icg = x.shape[1] // groups
+    outs = [conv1(x[:, g * icg:(g + 1) * icg], w[g * icg:(g + 1) * icg])
+            for g in range(groups)]
+    return jnp.concatenate(outs, axis=1)
+
+
 @register("conv2d_transpose")
 def conv2d_transpose(ctx, ins):
     lax = _lax()
@@ -61,13 +73,20 @@ def conv2d_transpose(ctx, ins):
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dil = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
-    out = lax.conv_transpose(
-        x, w, strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
-    return {"Output": [out]}
+
+    def conv1(xg, wg):
+        # paddle/torch kernel layout [in_c, out_c, kh, kw]: with
+        # transpose_kernel=True jax wants it marked as the FORWARD conv's
+        # kernel, i.e. O=in_c I=out_c -> "OIHW" (IOHW only shape-checks when
+        # in_c == out_c, and silently computes the wrong transpose even then)
+        return lax.conv_transpose(
+            xg, wg, strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dil,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True)
+
+    return {"Output": [_grouped_conv_transpose(x, w, groups, conv1)]}
 
 
 @register("conv3d")
@@ -298,3 +317,73 @@ def _interp_as(method):
 
 register("nearest_interp")(_interp_as("nearest"))
 register("bilinear_interp")(_interp_as("bilinear"))
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register("pool3d")
+def pool3d(ctx, ins):
+    """3D pooling (pool_op.cc NCDHW); same knobs as pool2d."""
+    lax = _lax()
+    jnp = _jnp()
+    x = ins["X"][0]
+    ptype = ctx.attr("pooling_type", "max")
+    k = _triple(ctx.attr("ksize", [2, 2, 2]))
+    s = _triple(ctx.attr("strides", [2, 2, 2]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x, axis=(2, 3, 4), keepdims=True)]}
+    if ctx.attr("adaptive", False):
+        n, c, d, h, w = x.shape
+        xb = x.reshape(n, c, k[0], d // k[0], k[1], h // k[1], k[2], w // k[2])
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(xb, axis=(3, 5, 7))]}
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    if ptype == "max":
+        out = lax.reduce_window(x, np.asarray(-np.inf, x.dtype), lax.max,
+                                window, strides, pads)
+        return {"Out": [out]}
+    summed = lax.reduce_window(x, np.asarray(0, x.dtype), lax.add, window,
+                               strides, pads)
+    if ctx.attr("exclusive", True) and any(p):
+        cnt = lax.reduce_window(jnp.ones_like(x), np.asarray(0, x.dtype),
+                                lax.add, window, strides, pads)
+        return {"Out": [summed / cnt]}
+    return {"Out": [summed / (k[0] * k[1] * k[2])]}
+
+
+@register("conv3d_transpose")
+def conv3d_transpose(ctx, ins):
+    lax = _lax()
+    x, w = ins["Input"][0], ins["Filter"][0]   # w: [in_c, out_c/g, kd, kh, kw]
+    strides = _triple(ctx.attr("strides", [1, 1, 1]))
+    pads = _triple(ctx.attr("paddings", [0, 0, 0]))
+    dil = _triple(ctx.attr("dilations", [1, 1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+
+    def conv1(xg, wg):
+        return lax.conv_transpose(
+            xg, wg, strides=strides, padding=[(p, p) for p in pads],
+            rhs_dilation=dil, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            transpose_kernel=True)
+
+    return {"Output": [_grouped_conv_transpose(x, w, groups, conv1)]}
+
+
+@register("trilinear_interp")
+def trilinear_interp(ctx, ins):
+    import jax
+    x = ins["X"][0]                            # [B, C, D, H, W]
+    out_d = int(ctx.attr("out_d"))
+    out_h = int(ctx.attr("out_h"))
+    out_w = int(ctx.attr("out_w"))
+    out = jax.image.resize(x, x.shape[:2] + (out_d, out_h, out_w),
+                           method="trilinear")
+    return {"Out": [out.astype(x.dtype)]}

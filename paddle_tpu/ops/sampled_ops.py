@@ -1,0 +1,88 @@
+"""Sampled / hierarchical output-layer ops: nce, hsigmoid.
+
+Reference: paddle/fluid/operators/nce_op.* (noise-contrastive estimation with
+a uniform/custom sampler) and hierarchical_sigmoid_op.* (tree-structured
+binary logistic output). TPU-native notes:
+  * nce samples its negatives in-graph from the op's PRNG (ctx.rng()) -- the
+    reference's CPU-side sampler state disappears; gathers of the sampled
+    weight rows are MXU-friendly dense ops and the scatter-add gradient falls
+    out of auto-vjp.
+  * hsigmoid uses a complete binary tree over the classes addressed by the
+    label's binary digits, so path codes are computed with static bit ops --
+    no LoD path tables. Weight holds 2^ceil(log2(N))-1 internal nodes (the
+    reference's custom-tree PathTable/PathCode variant raises).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("nce", nondiff_inputs=("Label",))
+def nce(ctx, ins):
+    """Cost [B, 1]: binary NLL of the true class vs num_neg_samples uniform
+    negatives, with the uniform-sampler logQ correction (nce_op.h:91)."""
+    import jax
+    jnp = _jnp()
+    x = ins["Input"][0]                       # [B, D]
+    label = ins["Label"][0].reshape(-1).astype("int32")
+    w = ins["Weight"][0]                      # [N, D]
+    b = ins.get("Bias", [None])[0]            # [N]
+    n_classes = int(ctx.attr("num_total_classes"))
+    k = int(ctx.attr("num_neg_samples", 10))
+
+    neg = jax.random.randint(ctx.rng(), (k,), 0, n_classes, "int32")
+    true_logit = jnp.sum(x * w[label], axis=1, keepdims=True)   # [B, 1]
+    neg_logit = x @ w[neg].T                                    # [B, k]
+    if b is not None:
+        true_logit = true_logit + b[label][:, None]
+        neg_logit = neg_logit + b[neg][None, :]
+    # uniform sampler: q = 1/N; correction log(k*q)
+    log_kq = math.log(k / n_classes)
+    pos_cost = -jax.nn.log_sigmoid(true_logit - log_kq)
+    neg_cost = -jnp.sum(jax.nn.log_sigmoid(-(neg_logit - log_kq)),
+                        axis=1, keepdims=True)
+    return {"Cost": [pos_cost + neg_cost]}
+
+
+def hsigmoid_num_nodes(num_classes: int) -> int:
+    """Internal-node count of the complete binary tree (layer-side helper for
+    sizing the weight parameter)."""
+    depth = max(1, math.ceil(math.log2(max(num_classes, 2))))
+    return 2 ** depth - 1
+
+
+@register("hsigmoid", nondiff_inputs=("Label",))
+def hsigmoid(ctx, ins):
+    """Cost [B, 1]: sum over the label's root-to-leaf path of binary logistic
+    losses (hierarchical_sigmoid_op.h:79)."""
+    import jax
+    jnp = _jnp()
+    x = ins["Input"][0]                       # [B, D]
+    label = ins["Label"][0].reshape(-1).astype("int32")
+    w = ins["W"][0]                           # [2^depth - 1, D]
+    b = ins.get("Bias", [None])[0]
+    n_classes = int(ctx.attr("num_classes"))
+    depth = max(1, math.ceil(math.log2(max(n_classes, 2))))
+
+    # At level d (0=root) the node index is 2^d - 1 + (label >> (depth - d)),
+    # and the branch bit taken there is bit (depth - 1 - d) of the label.
+    costs = []
+    for d in range(depth):
+        node = (2 ** d - 1) + (label >> (depth - d))
+        bit = (label >> (depth - 1 - d)) & 1          # 1 -> right child
+        logit = jnp.sum(x * w[node], axis=1)
+        if b is not None:
+            logit = logit + b.reshape(-1)[node]
+        sign = 1.0 - 2.0 * bit.astype(x.dtype)        # left: +, right: -
+        costs.append(-jax.nn.log_sigmoid(sign * logit))
+    cost = sum(costs)[:, None]
+    return {"Cost": [cost], "PreOut": [cost]}

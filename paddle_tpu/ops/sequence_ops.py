@@ -125,6 +125,159 @@ def sequence_concat(ctx, ins):
     return {"Out": [jnp.concatenate([x for x in ins["X"] if x is not None], axis=-1)]}
 
 
+@register("sequence_conv", nondiff_inputs=("Length",))
+def sequence_conv(ctx, ins):
+    """Context-window convolution over time (sequence_conv_op.*):
+    X [B, T, D], Filter [context_length*D, F]; frames outside [0, len) are
+    zero (the reference's zero-padded context)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    f = ins["Filter"][0]
+    lengths = ins.get("Length", [None])[0]
+    clen = int(ctx.attr("context_length", 3))
+    cstart = int(ctx.attr("context_start", -((clen - 1) // 2)))
+    B, T, D = x.shape
+    if lengths is not None:
+        x = x * _mask(lengths, T, x.dtype)[:, :, None]
+    cols = []
+    for o in range(cstart, cstart + clen):
+        if o < 0:
+            sh = jnp.pad(x, [(0, 0), (-o, 0), (0, 0)])[:, :T]
+        elif o > 0:
+            sh = jnp.pad(x, [(0, 0), (0, o), (0, 0)])[:, o:]
+        else:
+            sh = x
+        cols.append(sh)
+    ctxmat = jnp.concatenate(cols, axis=2)         # [B, T, clen*D]
+    return {"Out": [ctxmat @ f]}
+
+
+@register("sequence_pad", nondiff_inputs=("Length", "PadValue"))
+def sequence_pad(ctx, ins):
+    """Fill positions past each row's length with pad_value
+    (sequence_pad_op: LoD->padded; here padded in, pad value normalized).
+    The pad value is the optional PadValue input (reference passes a
+    Variable) or the pad_value attr."""
+    jnp = _jnp()
+    x, lengths = ins["X"][0], ins["Length"][0]
+    pv = ins.get("PadValue", [None])[0]
+    v = (pv.reshape(()).astype(x.dtype) if pv is not None
+         else jnp.asarray(float(ctx.attr("pad_value", 0.0)), x.dtype))
+    m = _mask(lengths, x.shape[1], x.dtype).reshape(
+        x.shape[0], x.shape[1], *([1] * (x.ndim - 2)))
+    return {"Out": [jnp.where(m > 0, x, v)], "Length": [lengths]}
+
+
+@register("sequence_unpad", nondiff_inputs=("Length",))
+def sequence_unpad(ctx, ins):
+    """Zero out the pad tail (sequence_unpad_op). XLA cannot produce the
+    ragged LoD rows of the reference, so the result stays padded with a
+    zeroed tail + the Length vector carried along."""
+    jnp = _jnp()
+    x, lengths = ins["X"][0], ins["Length"][0]
+    m = _mask(lengths, x.shape[1], x.dtype).reshape(
+        x.shape[0], x.shape[1], *([1] * (x.ndim - 2)))
+    return {"Out": [x * m]}
+
+
+def _seq_slice_infer(op, block):
+    # Offset's concrete batch vs X's dyn-batch sentinel breaks eval_shape
+    xv = block.find_var_recursive(op.inputs["X"][0])
+    shape = (xv.shape[0], op.attr("out_len")) + tuple(xv.shape[2:])
+    out = op.outputs["Out"][0]
+    v = block.find_var_recursive(out)
+    if v is None:
+        block.create_var(out, shape, xv.dtype)
+    else:
+        v.shape = shape
+
+
+@register("sequence_slice", nondiff_inputs=("Offset", "Length"),
+          infer_shape=_seq_slice_infer)
+def sequence_slice(ctx, ins):
+    """Per-row slice x[b, offset[b] : offset[b]+out_len] (sequence_slice_op).
+    The slice length must be static (attr out_len); offsets are runtime."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    off = ins["Offset"][0].reshape(-1).astype("int32")
+    out_len = int(ctx.attr("out_len"))
+    idx = off[:, None] + jnp.arange(out_len)[None, :]
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.take_along_axis(x, idx, axis=1)]}
+
+
+@register("sequence_enumerate", grad=None, nondiff_inputs=("X", "Length"))
+def sequence_enumerate(ctx, ins):
+    """Sliding windows of ids (sequence_enumerate_op): X [B, T] ->
+    Out [B, T, win], positions past the row length = pad_value."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    lengths = ins.get("Length", [None])[0]
+    win = int(ctx.attr("win_size"))
+    pad = int(ctx.attr("pad_value", 0))
+    B, T = x.shape
+    padded = jnp.pad(x, [(0, 0), (0, win - 1)], constant_values=pad)
+    out = jnp.stack([padded[:, k:k + T] for k in range(win)], axis=2)
+    pos = jnp.arange(T)[None, :, None] + jnp.arange(win)[None, None, :]
+    lim = (lengths.reshape(-1, 1, 1) if lengths is not None
+           else jnp.full((B, 1, 1), T))
+    return {"Out": [jnp.where(pos < lim, out, pad).astype(x.dtype)]}
+
+
+@register("sequence_erase", grad=None, nondiff_inputs=("X", "Length"))
+def sequence_erase(ctx, ins):
+    """Remove tokens in attr `tokens`, compacting survivors to the front
+    (sequence_erase_op). Output stays [B, T] zero-padded + new lengths."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    lengths = ins.get("Length", [None])[0]
+    tokens = list(ctx.attr("tokens", []))
+    B, T = x.shape
+    valid = (jnp.arange(T)[None, :] < lengths.reshape(-1, 1)
+             if lengths is not None else jnp.ones((B, T), bool))
+    keep = valid
+    for t in tokens:
+        keep = keep & (x != t)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    n = jnp.sum(keep, axis=1)
+    out = jnp.where(jnp.arange(T)[None, :] < n[:, None], compacted, 0)
+    return {"Out": [out.astype(x.dtype)], "OutLength": [n.astype("int64")]}
+
+
+@register("sequence_reshape")
+def sequence_reshape(ctx, ins):
+    x = ins["X"][0]                      # [B, T, D]
+    new_dim = int(ctx.attr("new_dim"))
+    B = x.shape[0]
+    return {"Out": [x.reshape(B, -1, new_dim)]}
+
+
+@register("sequence_scatter", nondiff_inputs=("Ids",))
+def sequence_scatter(ctx, ins):
+    """x[b, ids[b, k]] += updates[b, k] (sequence_scatter_op)."""
+    jnp = _jnp()
+    x, ids, upd = ins["X"][0], ins["Ids"][0].astype("int32"), ins["Updates"][0]
+    B = x.shape[0]
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], ids.shape)
+    return {"Out": [x.at[bidx, ids].add(upd)]}
+
+
+@register("sequence_expand_as", nondiff_inputs=("Y",))
+def sequence_expand_as(ctx, ins):
+    """Row-wise repeat to match Y's rows; like sequence_expand, the counts
+    must be static on TPU (attr ref_lengths)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    ref = ctx.attr("ref_lengths", None)
+    if ref is None:
+        raise NotImplementedError(
+            "sequence_expand_as needs static per-row counts on TPU: pass "
+            "attr 'ref_lengths' (dynamic LoD output shapes cannot compile)")
+    idx = jnp.asarray(np.repeat(np.arange(len(ref)), ref).astype("int32"))
+    return {"Out": [jnp.take(x, idx, axis=0)]}
+
+
 @register("im2sequence")
 def im2sequence(ctx, ins):
     import jax

@@ -325,6 +325,37 @@ def test_tensor_array_body_value_needs_like():
     np.testing.assert_allclose(rv, 2 * xv, rtol=1e-6)
 
 
+def test_switch_inside_while_body():
+    """Regression: a multi-case Switch inside a While body must resolve its
+    deeper case conditions and branch reads through declared inputs (the
+    block_runner only merges the top-level env)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0)
+        limit = layers.fill_constant([1], "float32", 4)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            c1 = layers.less_than(i, layers.fill_constant([1], "float32", 2))
+            c2 = layers.less_than(i, layers.fill_constant([1], "float32", 3))
+            with layers.Switch() as switch:
+                with switch.case(c1):
+                    layers.assign(layers.elementwise_add(
+                        acc, layers.fill_constant([1], "float32", 1.0)), acc)
+                with switch.case(c2):
+                    layers.assign(layers.elementwise_add(
+                        acc, layers.fill_constant([1], "float32", 10.0)), acc)
+                with switch.default():
+                    layers.assign(layers.elementwise_add(
+                        acc, layers.fill_constant([1], "float32", 100.0)), acc)
+            layers.increment(i, in_place=True)
+            layers.less_than(i, limit, cond=cond)
+    accv, = _run(main, {}, [acc])
+    # i=0,1 -> +1; i=2 -> +10; i=3 -> +100
+    np.testing.assert_allclose(accv, [112.0], rtol=1e-6)
+
+
 def test_subblock_persistable_write_must_escape():
     """A persistable written inside a sub-block whose op doesn't output it is
     a silent-loss bug -- the executor must refuse (VERDICT r2 weak #4)."""
