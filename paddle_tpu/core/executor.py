@@ -144,7 +144,12 @@ def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
         try:
             outs = d.lower(ctx, ins)
         except Exception as e:
-            raise RuntimeError(f"lowering failed for op {op!r}: {e}") from e
+            stack = op.creation_stack_str() if hasattr(
+                op, "creation_stack_str") else ""
+            where = (f"\nop created at (most recent call last):\n{stack}"
+                     if stack else "")
+            raise RuntimeError(
+                f"lowering failed for op {op!r}: {e}{where}") from e
         from .. import flags as _flags
         check_dtype = _flags.get_flag("check_dtype")
         for slot, names in op.outputs.items():

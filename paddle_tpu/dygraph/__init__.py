@@ -5,3 +5,4 @@ from .nn import (Layer, Linear, FC, Conv2D, Pool2D, Embedding, BatchNorm,  # noq
                  LayerNorm, Dropout, Sequential)
 from .optimizer import SGDOptimizer, AdamOptimizer, MomentumOptimizer  # noqa
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .parallel import DataParallel, ParallelStrategy, prepare_context  # noqa

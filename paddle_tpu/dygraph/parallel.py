@@ -1,0 +1,114 @@
+"""Dygraph DataParallel (reference: python/paddle/fluid/dygraph/parallel.py:84).
+
+TPU-native design: the reference wraps a Layer so that after ``backward()``
+each trainer process all-reduces its gradients over NCCL
+(apply_collective_grads, parallel.py:178). Here the same effect falls out of
+GSPMD semantics in *eager* mode: inputs are committed to the mesh with the
+batch dim sharded over "dp" and parameters replicated, so every traced op --
+forward and the tape-replayed backward -- executes SPMD across the devices,
+and the gradient of a replicated parameter w.r.t. a dp-sharded loss is the
+cross-device reduction the reference implemented as an explicit allreduce.
+``scale_loss``/``apply_collective_grads`` therefore exist for API parity and
+are no-ops (documented below).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import VarBase
+from .nn import Layer
+
+
+class ParallelStrategy:
+    """Parity shell for the reference's ParallelStrategy (parallel.py:37);
+    rank discovery comes from jax instead of env vars."""
+
+    def __init__(self):
+        import jax
+        self.nranks = jax.device_count()
+        self.local_rank = jax.process_index()
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy: Optional[ParallelStrategy] = None):
+    """Reference dygraph/parallel.py:prepare_context. No NCCL ring to build:
+    returns a strategy describing the mesh the wrapper will use."""
+    return strategy or ParallelStrategy()
+
+
+class DataParallel(Layer):
+    """Run a dygraph Layer data-parallel over all local devices.
+
+    Usage (reference parallel.py:84 shape)::
+
+        strategy = dygraph.prepare_context()
+        model = dygraph.DataParallel(MyLayer(), strategy)
+        loss = model(x, y)
+        loss = model.scale_loss(loss)      # no-op, parity
+        loss.backward()
+        model.apply_collective_grads()     # no-op, parity
+        opt.minimize(loss)
+
+    The global batch is fed whole (NOT pre-split per device: XLA shards it);
+    it must be divisible by the device count.
+    """
+
+    def __init__(self, layers: Layer, strategy: Optional[ParallelStrategy] = None,
+                 mesh=None):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        super().__init__("data_parallel")
+        self._layers = layers
+        self._strategy = strategy or ParallelStrategy()
+        if mesh is None:
+            devs = jax.devices()
+            mesh = Mesh(np.array(devs), ("dp",))
+        self._mesh = mesh
+        self._replicated = NamedSharding(mesh, P())
+        self._batch_sharded = NamedSharding(mesh, P("dp"))
+        # commit parameters replicated on the mesh so eager ops compute SPMD
+        for p in layers.parameters():
+            p.value = jax.device_put(p.value, self._replicated)
+
+    def _shard(self, v):
+        import jax
+        if not isinstance(v, VarBase):
+            return v
+        if v.shape and v.shape[0] % self._mesh.shape["dp"] == 0:
+            sharded = jax.device_put(v.value, self._batch_sharded)
+        else:
+            sharded = jax.device_put(v.value, self._replicated)
+        out = VarBase(sharded, stop_gradient=v.stop_gradient, name=v.name)
+        return out
+
+    def forward(self, *inputs, **kwargs):
+        inputs = [self._shard(v) for v in inputs]
+        kwargs = {k: self._shard(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """Reference parallel.py:120 divides by nranks because each trainer
+        computes a local-batch loss. Here the loss is already the global-batch
+        reduction (the batch dim is sharded, not replicated), so this is the
+        identity -- kept so ported training loops run unchanged."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Reference parallel.py:178 allreduces grads over NCCL. Under GSPMD
+        the gradient of a replicated param is already the cross-device sum --
+        XLA inserted the reduction during the backward ops. No-op."""
+        return
+
+    # -- delegation --------------------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self):
+        return self._layers.state_dict()
+
+    def set_dict(self, d):
+        return self._layers.set_dict(d)
+
+    load_dict = set_dict

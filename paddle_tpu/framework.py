@@ -19,12 +19,37 @@ from __future__ import annotations
 
 import copy
 import json
+import os as _os
 import threading
+import traceback
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from . import unique_name
+
+_PKG_DIR = _os.path.dirname(_os.path.abspath(__file__))
+
+
+def _user_stack(limit: int = 6):
+    """Frames outside paddle_tpu where the current op is being created --
+    the reference's op creation callstack (op_call_stack.cc), attached to
+    lowering errors so a failure in a 200-op program names the user line.
+    Walks raw frames (no source-line loading: FrameSummary reads the line
+    lazily, only when an error actually formats the stack)."""
+    import sys
+    frames = []
+    f = sys._getframe(2)
+    depth = 0
+    while f is not None and depth < 50 and len(frames) < limit:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR):
+            frames.append(traceback.FrameSummary(fn, f.f_lineno,
+                                                 f.f_code.co_name,
+                                                 lookup_line=False))
+        f = f.f_back
+        depth += 1
+    return list(reversed(frames))
 
 # --------------------------------------------------------------------------------------
 # dtypes
@@ -221,6 +246,16 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        self._creation_stack = _user_stack()
+
+    def creation_stack_str(self) -> str:
+        """User-code frames where this op was built (reference
+        framework/op_call_stack.cc:1 attaches these to runtime errors)."""
+        if not self._creation_stack:
+            return ""
+        return "".join(f'  File "{f.filename}", line {f.lineno}, '
+                       f"in {f.name}\n    {f.line}\n"
+                       for f in self._creation_stack)
 
     def input(self, slot) -> List[str]:
         return self.inputs.get(slot, [])

@@ -16,6 +16,11 @@ from ..layer_helper import LayerHelper
 from .nn import _out, _var
 
 
+def _int_tuple(v, n):
+    """int-or-sequence -> list of n ints (the conv/pool size normalizer)."""
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
 def _simple(op_type, out_slot="Out"):
     """Wrapper factory for single-X-input ops with attrs."""
     def layer(x, name=None, **attrs):
@@ -57,11 +62,11 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    def pair(v):
-        return [v, v] if isinstance(v, int) else list(v)
-    return _simple("unfold")(x, name=name, kernel_sizes=pair(kernel_sizes),
-                             strides=pair(strides), paddings=pair(paddings),
-                             dilations=pair(dilations))
+    return _simple("unfold")(x, name=name,
+                             kernel_sizes=_int_tuple(kernel_sizes, 2),
+                             strides=_int_tuple(strides, 2),
+                             paddings=_int_tuple(paddings, 2),
+                             dilations=_int_tuple(dilations, 2))
 
 
 def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
@@ -106,6 +111,10 @@ def multiplex(inputs, index):
 
 
 def crop_tensor(x, shape=None, offsets=None, name=None):
+    if shape is None:
+        raise ValueError("crop_tensor on TPU needs a static `shape` list "
+                         "(a Variable shape cannot drive an output shape "
+                         "under XLA)")
     helper = LayerHelper("crop_tensor", name=name)
     out = _out(helper, x.dtype)
     helper.append_op("crop_tensor", inputs={"X": [x]}, outputs={"Out": [out]},
@@ -162,7 +171,8 @@ def uniform_random_batch_size_like(input, shape, dtype="float32",
     helper.append_op("uniform_random_batch_size_like",
                      inputs={"Input": [input]}, outputs={"Out": [out]},
                      attrs={"shape": list(shape), "dtype": convert_dtype(dtype),
-                            "input_dim_idx": input_dim_idx, "min": min,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx, "min": min,
                             "max": max})
     return _var(helper, out)
 
@@ -175,7 +185,8 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
     helper.append_op("gaussian_random_batch_size_like",
                      inputs={"Input": [input]}, outputs={"Out": [out]},
                      attrs={"shape": list(shape), "dtype": convert_dtype(dtype),
-                            "input_dim_idx": input_dim_idx, "mean": mean,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx, "mean": mean,
                             "std": std})
     return _var(helper, out)
 
@@ -677,8 +688,7 @@ def expand_as(x, target_tensor, name=None):
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
                 out_stride=1, name=None):
-    def pair(v):
-        return [v, v] if isinstance(v, int) else list(v)
+    pair = lambda v: _int_tuple(v, 2)
     helper = LayerHelper("im2sequence", name=name)
     out = _out(helper, input.dtype)
     helper.append_op("im2sequence", inputs={"X": [input]},
@@ -762,9 +772,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            act=None, name=None, data_format="NCDHW"):
     helper = LayerHelper("conv3d", param_attr=param_attr, bias_attr=bias_attr,
                          act=act, name=name)
-
-    def triple(v):
-        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+    triple = lambda v: _int_tuple(v, 3)
     c_in = input.shape[1]
     fs = triple(filter_size)
     groups = groups or 1
@@ -789,9 +797,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      act=None, name=None):
     helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
-
-    def triple(v):
-        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+    triple = lambda v: _int_tuple(v, 3)
     c_in = input.shape[1]
     fs = triple(filter_size)
     w = helper.create_parameter(param_attr,
@@ -815,9 +821,7 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
            ceil_mode=False, name=None, exclusive=True, adaptive=False):
     helper = LayerHelper("pool3d", name=name)
-
-    def triple(v):
-        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+    triple = lambda v: _int_tuple(v, 3)
     out = _out(helper, input.dtype)
     helper.append_op("pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
                      attrs={"pooling_type": pool_type,

@@ -110,8 +110,10 @@ def linear_chain_crf(ctx, ins):
     """Negative log-likelihood of tag paths (linear_chain_crf_op.cc).
 
     Emission [B, T, N]; Transition [N+2, N] (row 0 start, row 1 stop, rest
-    pairwise); Label [B, T]; Length [B]. LogLikelihood [B, 1] (negated cost,
-    matching the reference's output that callers negate into a loss).
+    pairwise); Label [B, T]; Length [B]. LogLikelihood [B, 1] holds
+    ``logZ - score(gold)`` -- i.e. the NEGATIVE log-likelihood, matching the
+    reference kernel's ``return -ll`` (linear_chain_crf_op.h:220): callers
+    minimize the output directly (the label_semantic_roles pattern).
     """
     import jax
     jnp = _jnp()
@@ -142,8 +144,8 @@ def linear_chain_crf(ctx, ins):
 
     a, _ = jax.lax.scan(step, a0, jnp.arange(1, T))
     logz = jax.scipy.special.logsumexp(a + stop[None, :], axis=1)
-    ll = (gold - logz)[:, None]
-    return {"LogLikelihood": [ll.astype(ins["Emission"][0].dtype)]}
+    nll = (logz - gold)[:, None]
+    return {"LogLikelihood": [nll.astype(ins["Emission"][0].dtype)]}
 
 
 @register("crf_decoding", grad=None,

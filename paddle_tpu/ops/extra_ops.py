@@ -310,15 +310,22 @@ def row_conv(ctx, ins):
     return {"Out": [out]}
 
 
+def _batch_like_shape(ctx, ins):
+    """shape[output_dim_idx] <- input.shape[input_dim_idx] (the reference's
+    BatchSizeLikeOp contract, batch_size_like.h)."""
+    ref = ins["Input"][0]
+    shape = list(ctx.attr("shape"))
+    shape[int(ctx.attr("output_dim_idx", 0))] = \
+        ref.shape[int(ctx.attr("input_dim_idx", 0))]
+    return tuple(shape)
+
+
 @register("uniform_random_batch_size_like", grad=None)
 def uniform_random_batch_size_like(ctx, ins):
     import jax
-    ref = ins["Input"][0]
-    shape = list(ctx.attr("shape"))
-    shape[int(ctx.attr("input_dim_idx", 0))] = \
-        ref.shape[int(ctx.attr("input_dim_idx", 0))]
+    shape = _batch_like_shape(ctx, ins)
     lo, hi = float(ctx.attr("min", -1.0)), float(ctx.attr("max", 1.0))
-    out = jax.random.uniform(ctx.rng(), tuple(shape),
+    out = jax.random.uniform(ctx.rng(), shape,
                              np.dtype(ctx.attr("dtype", "float32")), lo, hi)
     return {"Out": [out]}
 
@@ -326,14 +333,11 @@ def uniform_random_batch_size_like(ctx, ins):
 @register("gaussian_random_batch_size_like", grad=None)
 def gaussian_random_batch_size_like(ctx, ins):
     import jax
-    ref = ins["Input"][0]
-    shape = list(ctx.attr("shape"))
-    shape[int(ctx.attr("input_dim_idx", 0))] = \
-        ref.shape[int(ctx.attr("input_dim_idx", 0))]
+    shape = _batch_like_shape(ctx, ins)
     mean = float(ctx.attr("mean", 0.0))
     std = float(ctx.attr("std", 1.0))
     out = mean + std * jax.random.normal(
-        ctx.rng(), tuple(shape), np.dtype(ctx.attr("dtype", "float32")))
+        ctx.rng(), shape, np.dtype(ctx.attr("dtype", "float32")))
     return {"Out": [out]}
 
 

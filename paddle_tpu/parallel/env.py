@@ -81,12 +81,17 @@ def _coordinator() -> Optional[str]:
 
 def init_parallel_env(coordinator_address: Optional[str] = None,
                       num_processes: Optional[int] = None,
-                      process_id: Optional[int] = None) -> ParallelEnv:
+                      process_id: Optional[int] = None,
+                      timeout_seconds: int = 300) -> ParallelEnv:
     """Connect this host into the job (the c_gen_nccl_id + c_comm_init analog).
 
     Single-process (no coordinator configured) is a no-op so the same training
     script runs unmodified on one host -- matching the reference's behavior
     when trainers_num == 1 (distribute_transpiler.py:308).
+
+    ``timeout_seconds`` bounds the rendezvous (the heartbeat deadline of
+    reference heart_beat_monitor.h:38): a missing rank produces a clean
+    timeout error naming the coordinator instead of hanging forever.
     """
     global _initialized
     import jax
@@ -96,12 +101,77 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
     n = num_processes if num_processes is not None else get_world_size()
     if addr is None or n <= 1:
         return ParallelEnv()  # single-host: nothing to bootstrap
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=n,
-        process_id=process_id if process_id is not None else get_rank())
+    rank = process_id if process_id is not None else get_rank()
+    if rank != 0:
+        # jax's distributed client LOG(FATAL)-aborts the whole process when
+        # its registration RPC deadlines -- uncatchable from Python. Probe the
+        # coordinator ourselves first so a down/wrong coordinator surfaces as
+        # a clean Python error naming the address (heartbeat deadline,
+        # reference heart_beat_monitor.h:38).
+        import socket
+        import time
+        host, port = addr.rsplit(":", 1)
+        deadline = time.time() + timeout_seconds
+        while True:
+            try:
+                socket.create_connection((host, int(port)), timeout=2).close()
+                break
+            except OSError as e:
+                if time.time() >= deadline:
+                    raise RuntimeError(
+                        f"init_parallel_env: rank {rank}/{n} could not reach "
+                        f"the coordinator at {addr} within {timeout_seconds}s "
+                        f"-- rank 0 is down or the address is wrong "
+                        f"({e})") from e
+                time.sleep(0.5)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=n, process_id=rank,
+            initialization_timeout=timeout_seconds)
+    except Exception as e:
+        raise RuntimeError(
+            f"init_parallel_env: rank {rank}/{n} failed to join the job at "
+            f"coordinator {addr} within {timeout_seconds}s -- a rank is down "
+            f"or the address is wrong ({e})") from e
     _initialized = True
     return ParallelEnv()
+
+
+def barrier(name: str = "paddle_tpu_barrier"):
+    """Block until every process reaches this point (the reference's
+    Communicator barrier). There is NO caller-settable deadline: the sync is
+    a psum over all devices, and a dead peer surfaces when jax's own
+    coordinator heartbeat lapses (minutes). For bounded waits around the
+    rendezvous itself use init_parallel_env(timeout_seconds=...)."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
+def monitored_run(step_fn, max_consecutive_failures: int = 1,
+                  on_failure=None):
+    """Wrap a per-step callable with failure accounting (the trainer-side
+    analog of heart_beat_monitor.h: detect a wedged/failing step loop and
+    surface it instead of looping forever). Returns step_fn's value;
+    re-raises after ``max_consecutive_failures`` consecutive exceptions."""
+    failures = {"n": 0}
+
+    def run(*a, **kw):
+        try:
+            out = step_fn(*a, **kw)
+            failures["n"] = 0
+            return out
+        except Exception:
+            failures["n"] += 1
+            if on_failure is not None:
+                on_failure(failures["n"])
+            if failures["n"] >= max_consecutive_failures:
+                raise
+            return None
+
+    return run
 
 
 def local_device_count() -> int:

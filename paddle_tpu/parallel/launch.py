@@ -26,11 +26,30 @@ def _free_port() -> int:
 
 
 def launch(nproc: int, script_argv, coordinator: str = None,
-           devices_per_proc: int = None):
-    """Spawn ``nproc`` copies of ``script_argv``; returns exit codes."""
-    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
-    endpoints = ",".join(coordinator for _ in range(nproc))
-    procs = []
+           devices_per_proc: int = None, log_dir: str = None,
+           poll_interval: float = 0.5):
+    """Spawn ``nproc`` copies of ``script_argv``; returns exit codes.
+
+    Failure handling (reference heart_beat_monitor.h:38 analog for the
+    launcher): ranks are monitored while running -- when one dies with a
+    nonzero code, the survivors (which would otherwise hang in the next
+    collective forever) are terminated and the dead rank's log tail is
+    printed with its rank id. Each rank gets a DISTINCT endpoint
+    (endpoints[0] is the coordinator), matching the reference's launcher
+    contract where user code indexes PADDLE_TRAINER_ENDPOINTS[rank].
+    """
+    import time
+    if coordinator:
+        host, port0 = coordinator.rsplit(":", 1)
+        eps = [coordinator] + [f"{host}:{_free_port()}"
+                               for _ in range(nproc - 1)]
+    else:
+        eps = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
+    coordinator = eps[0]
+    endpoints = ",".join(eps)
+    log_dir = log_dir or os.path.join(os.getcwd(), "launch_logs")
+    os.makedirs(log_dir, exist_ok=True)
+    procs, logs = [], []
     for rank in range(nproc):
         env = dict(os.environ)
         env.update({
@@ -41,15 +60,50 @@ def launch(nproc: int, script_argv, coordinator: str = None,
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(nproc),
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
-            "PADDLE_CURRENT_ENDPOINT": coordinator,
+            "PADDLE_CURRENT_ENDPOINT": eps[rank],
         })
         if devices_per_proc:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 f" --xla_force_host_platform_device_count="
                                 f"{devices_per_proc}").strip()
-        procs.append(subprocess.Popen([sys.executable] + list(script_argv),
-                                      env=env))
-    return [p.wait() for p in procs]
+        log_path = os.path.join(log_dir, f"rank{rank}.log")
+        logs.append(log_path)
+        lf = open(log_path, "wb")
+        try:
+            procs.append(subprocess.Popen([sys.executable] + list(script_argv),
+                                          env=env, stdout=lf, stderr=lf))
+        finally:
+            lf.close()   # the child holds its own copy of the fd
+    # monitor: a dead rank must not leave the others hanging in a collective
+    while True:
+        codes = [p.poll() for p in procs]
+        bad = [r for r, c in enumerate(codes) if c not in (None, 0)]
+        if bad:
+            for r, p in enumerate(procs):
+                if codes[r] is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()   # reap: no zombies, returncode always set
+            r = bad[0]
+            tail = b""
+            try:
+                with open(logs[r], "rb") as f:
+                    tail = f.read()[-4000:]
+            except OSError:
+                pass
+            sys.stderr.write(
+                f"\n[paddle_tpu.launch] rank {r} died with exit code "
+                f"{codes[r]}; terminated {sum(1 for c in codes if c is None)} "
+                f"surviving rank(s). Log tail ({logs[r]}):\n"
+                f"{tail.decode(errors='replace')}\n")
+            return [p.returncode for p in procs]
+        if all(c is not None for c in codes):
+            return list(codes)
+        time.sleep(poll_interval)
 
 
 def main():
@@ -57,13 +111,16 @@ def main():
     ap.add_argument("--nproc", type=int, default=1)
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--devices_per_proc", type=int, default=None)
+    ap.add_argument("--log_dir", default=None)
     ap.add_argument("script", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.script:
         ap.error("no training script given")
     codes = launch(args.nproc, args.script, args.coordinator,
-                   args.devices_per_proc)
-    sys.exit(max(codes))
+                   args.devices_per_proc, log_dir=args.log_dir)
+    # any non-clean rank (nonzero, signal-killed => negative, unreaped =>
+    # None) must fail the launch: max() would mask -11 behind a clean 0
+    sys.exit(0 if all(c == 0 for c in codes) else 1)
 
 
 if __name__ == "__main__":

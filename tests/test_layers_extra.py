@@ -275,7 +275,8 @@ def test_linear_chain_crf_and_decoding_vs_brute_force():
         s = trans[0, p[0]] + em[b, 0, p[0]] + trans[1, p[-1]]
         for t in range(1, L):
             s += trans[2 + p[t - 1], p[t]] + em[b, t, p[t]]
-        np.testing.assert_allclose(llv[b, 0], s - brute[b][1], rtol=1e-4)
+        # reference sign convention: output is logZ - gold (a cost)
+        np.testing.assert_allclose(llv[b, 0], brute[b][1] - s, rtol=1e-4)
         np.testing.assert_array_equal(pathv[b, :L], brute[b][0])
         assert (pathv[b, L:] == 0).all()
 

@@ -1,0 +1,121 @@
+"""Multi-host robustness tests (VERDICT r2 #8): dead-rank diagnosis in the
+launcher, bounded rendezvous in init_parallel_env, op creation-stack on
+executor errors (reference heart_beat_monitor.h:38, op_call_stack.cc:1)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_launch_reports_dead_rank(tmp_path):
+    """Rank 1 dies mid-run: the launcher must kill the survivor (which would
+    otherwise hang in the rendezvous/collective), return, and leave a log
+    naming the dead rank."""
+    from paddle_tpu.parallel.launch import launch
+    script = tmp_path / "dier.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        rank = int(os.environ["PROCESS_ID"])
+        if rank == 1:
+            print("rank 1 failing now", flush=True)
+            sys.exit(3)
+        time.sleep(60)   # rank 0 would hang forever without the monitor
+    """))
+    import time
+    t0 = time.time()
+    codes = launch(2, [str(script)], log_dir=str(tmp_path / "logs"),
+                   poll_interval=0.2)
+    assert time.time() - t0 < 30, "launcher failed to detect the dead rank"
+    assert codes[1] == 3
+    assert codes[0] != 0 or codes[0] is None  # terminated, not clean exit
+    log = (tmp_path / "logs" / "rank1.log").read_text()
+    assert "rank 1 failing now" in log
+
+
+def test_launch_distinct_endpoints(tmp_path):
+    """Each rank gets its own endpoint; endpoints[rank] ==
+    PADDLE_CURRENT_ENDPOINT (advisor r2 finding on the launcher contract)."""
+    from paddle_tpu.parallel.launch import launch
+    script = tmp_path / "epcheck.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(set(eps)) == len(eps), f"duplicate endpoints: {eps}"
+        assert os.environ["PADDLE_CURRENT_ENDPOINT"] == eps[rank]
+        assert os.environ["COORDINATOR_ADDRESS"] == eps[0]
+    """))
+    codes = launch(2, [str(script)], log_dir=str(tmp_path / "logs"))
+    assert codes == [0, 0], (tmp_path / "logs" / "rank0.log").read_text()
+
+
+def test_init_parallel_env_times_out_cleanly():
+    """A missing peer must produce an actionable error naming the coordinator
+    within the deadline, not an indefinite hang."""
+    code = textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import sys
+        sys.path.insert(0, %r)
+        from paddle_tpu.parallel import env as penv
+        try:
+            penv.init_parallel_env(coordinator_address="127.0.0.1:59999",
+                                   num_processes=2, process_id=1,
+                                   timeout_seconds=5)
+        except RuntimeError as e:
+            assert "127.0.0.1:59999" in str(e), str(e)
+            assert "rank 1/2" in str(e), str(e)
+            assert "could not reach" in str(e), str(e)
+            print("CLEAN_TIMEOUT")
+    """) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         timeout=120)
+    assert b"CLEAN_TIMEOUT" in out.stdout, out.stderr[-1500:]
+
+
+def test_executor_error_names_user_code_line():
+    """Lowering failures carry the op's creation stack (op_call_stack.cc)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        q = fluid.data("q", [2, 8, 4], "float32")
+        bad = fluid.layers.fused_attention(q, q, q, impl="ring")  # needs sp mesh
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError) as ei:
+            exe.run(main, feed={"q": np.zeros((2, 2, 8, 4), "float32")},
+                    fetch_list=[bad])
+    msg = str(ei.value)
+    assert "op created at" in msg
+    assert "test_robustness.py" in msg, msg
+
+
+def test_monitored_run_failure_accounting():
+    from paddle_tpu.parallel.env import monitored_run
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    seen = []
+    run = monitored_run(flaky, max_consecutive_failures=3,
+                        on_failure=seen.append)
+    assert run() is None and run() is None and run() == "ok"
+    assert seen == [1, 2]
+
+    def always():
+        raise ValueError("fatal")
+
+    run2 = monitored_run(always, max_consecutive_failures=2)
+    assert run2() is None
+    with pytest.raises(ValueError):
+        run2()
