@@ -17,6 +17,7 @@ from .core.backward import append_backward, gradients, calc_gradient  # noqa
 from .core import registry  # noqa: F401
 from . import layers  # noqa: F401
 from . import nets  # noqa: F401
+from . import dataset  # noqa: F401
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

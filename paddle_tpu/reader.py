@@ -26,17 +26,23 @@ class DataLoader:
     """Iterable feeder: yields feed dicts ready for Executor.run."""
 
     def __init__(self, feed_list: Sequence[Variable], capacity: int = 4,
-                 return_list: bool = False, use_double_buffer: bool = True):
+                 return_list: bool = False, use_double_buffer: bool = True,
+                 shard_by_host: Optional[bool] = None):
         self.feed_list = list(feed_list)
         self.capacity = capacity
         self.use_double_buffer = use_double_buffer
+        # multi-host: the generator yields the GLOBAL batch on every host and
+        # each host feeds its row-slice (the executor assembles the global
+        # array from per-host slices). None = auto (on when process_count>1).
+        self.shard_by_host = shard_by_host
         self._batch_fn: Optional[Callable[[], Iterable]] = None
 
     # -- construction (reference reader.py:73) -----------------------------------------
     @staticmethod
     def from_generator(feed_list, capacity=4, use_double_buffer=True,
-                       iterable=True, return_list=False):
-        return DataLoader(feed_list, capacity, return_list, use_double_buffer)
+                       iterable=True, return_list=False, shard_by_host=None):
+        return DataLoader(feed_list, capacity, return_list, use_double_buffer,
+                          shard_by_host)
 
     def set_batch_generator(self, fn, places=None):
         """fn() yields tuples/lists of arrays aligned with feed_list."""
@@ -78,13 +84,31 @@ class DataLoader:
         stop = object()
         exc: List[BaseException] = []
 
+        import jax
+        do_shard = (self.shard_by_host if self.shard_by_host is not None
+                    else jax.process_count() > 1)
+        if do_shard and jax.process_count() > 1:
+            from .parallel.env import shard_batch
+            # rank/world explicitly from jax: env-var discovery would no-op
+            # when jax.distributed was initialized outside init_parallel_env
+            rank, world = jax.process_index(), jax.process_count()
+
+            def _host_slice(v):
+                return shard_batch(v, rank, world)
+        else:
+            _host_slice = None
+
         def producer():
             try:
                 for batch in self._batch_fn():
                     vals = list(batch)
+                    if _host_slice is not None:
+                        # only arrays with a leading (batch) dim are sliced
+                        vals = [_host_slice(v)
+                                if getattr(v, "ndim", 0) > 0 else v
+                                for v in vals]
                     if self.use_double_buffer:
                         # stage on device while the consumer computes
-                        import jax
                         vals = [jax.device_put(v) if isinstance(
                             v, np.ndarray) else v for v in vals]
                     q.put(dict(zip(names, vals)))
