@@ -8,7 +8,7 @@ parallelism is SPMD sharding over device meshes, and custom kernels are Pallas.
 
 from . import unique_name  # noqa: F401
 from .framework import (Program, Block, Variable, Parameter, Operator,  # noqa
-                        program_guard, default_main_program,
+                        program_guard, device_guard, default_main_program,
                         default_startup_program, switch_main_program,
                         grad_var_name, convert_dtype)
 from . import ops  # noqa: F401  (registers the op library)

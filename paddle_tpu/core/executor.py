@@ -206,34 +206,38 @@ class Executor:
             pkey = (id(program), program._version, tuple(fetch_names))
             if not hasattr(self, "_prune_cache"):
                 self._prune_cache = {}
-            pruned = self._prune_cache.get(pkey)
-            if pruned is None:
-                pruned = program._prune(list(feed), fetch_names)
-                self._prune_cache[pkey] = pruned
+            entry = self._prune_cache.get(pkey)
+            # the entry retains the source program: after GC, CPython id reuse
+            # could otherwise hand a new Program another program's pruned graph
+            if entry is None or entry[0] is not program:
+                entry = (program, program._prune(list(feed), fetch_names))
+                self._prune_cache[pkey] = entry
                 while len(self._prune_cache) > self._CACHE_CAP:
                     self._prune_cache.pop(next(iter(self._prune_cache)))
-            program = pruned
+            program = entry[1]
 
         if compiled_wrapper is not None and compiled_wrapper.dist_strategy:
             ds = compiled_wrapper.dist_strategy
             compiled_wrapper.mesh  # force mesh build (fills default mesh_shape)
-            # (multi-host: each process feeds only its local slice, so the
-            #  global divisibility check does not apply to the local shape)
-            if jax.process_count() == 1:
-                for k, v in feed.items():
-                    shape = np.shape(v)
-                    spec = ds.data_spec(k, len(shape))
-                    for dim, axes in enumerate(spec):
-                        if axes is None or dim >= len(shape):
-                            continue
-                        n = 1
-                        for ax in (axes if isinstance(axes, tuple) else (axes,)):
-                            n *= ds.mesh_shape.get(ax, 1)
-                        if n > 1 and shape[dim] % n != 0:
-                            raise ValueError(
-                                f"feed {k!r} dim {dim} (={shape[dim]}) is not "
-                                f"divisible by mesh axes {axes!r} ({n} shards); "
-                                f"pad or drop the remainder batch")
+            pc = jax.process_count()
+            for k, v in feed.items():
+                shape = np.shape(v)
+                spec = ds.data_spec(k, len(shape))
+                for dim, axes in enumerate(spec):
+                    if axes is None or dim >= len(shape):
+                        continue
+                    n = 1
+                    for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                        n *= ds.mesh_shape.get(ax, 1)
+                    if n <= 1:
+                        continue
+                    # (multi-host local shapes depend on which mesh axes span
+                    #  processes -- validated where assembly happens below)
+                    if pc == 1 and shape[dim] % n != 0:
+                        raise ValueError(
+                            f"feed {k!r} dim {dim} (={shape[dim]}) is not "
+                            f"divisible by mesh axes {axes!r} ({n} "
+                            f"shards); pad or drop the remainder batch")
         state_in, state_out = self._state_names(program, feed, fetch_names)
         missing = [n for n in state_in if not scope.has_var(n) or
                    scope.find_var(n) is None]
@@ -284,10 +288,19 @@ class Executor:
                         for n, v in mut_vals.items()}
             ro_vals = {n: to_global(v, compiled.state_shardings[n])
                        for n, v in ro_vals.items()}
-            feed_vals = {
-                k: jax.make_array_from_process_local_data(
-                    compiled.feed_shardings[k], np.asarray(v))
-                for k, v in feed.items()}
+            feed_vals = {}
+            for k, v in feed.items():
+                try:
+                    feed_vals[k] = jax.make_array_from_process_local_data(
+                        compiled.feed_shardings[k], np.asarray(v))
+                except Exception as e:
+                    raise ValueError(
+                        f"feed {k!r}: local shape {np.shape(v)} on host "
+                        f"{jax.process_index()}/{jax.process_count()} does "
+                        f"not assemble under sharding "
+                        f"{compiled.feed_shardings[k]} -- each host feeds "
+                        f"its slice of the global batch (global/num_hosts "
+                        f"rows for a dp-sharded dim 0); ({e})") from e
         else:
             feed_vals = {k: _as_device_array(v) for k, v in feed.items()}
         # The PRNG key for run k of a program is fold_in(PRNGKey(seed), k); the

@@ -32,8 +32,14 @@ import numpy as np
 
 from ..framework import Block, Operator, convert_dtype, grad_var_name
 
-# Sentinel used to stand in for -1 (unknown batch) during eval_shape-based inference.
+# Sentinels standing in for -1 (unknown batch) during eval_shape-based
+# inference. Inference runs TWICE with two coprime primes; an output dim is
+# dynamic iff it differs between the runs -- exact provenance, no collision
+# with a real dim that happens to be a multiple of the sentinel (a 7919-wide
+# layer stays static). The primes stay small because some lowerings
+# materialize real arrays sized by these dims even under eval_shape.
 _DYN = 7919
+_DYN2 = 7927
 EMPTY_VAR = "@EMPTY@"
 
 
@@ -321,39 +327,56 @@ def _eval_shape_infer(d: OpDef, op: Operator, block: Block):
     import jax
     import jax.numpy as jnp
 
-    ins_struct: Dict[str, List] = {}
-    for slot, names in op.inputs.items():
-        vals = []
-        for n in names:
-            if n == EMPTY_VAR:
-                vals.append(None)
-                continue
-            v = block.find_var_recursive(n)
-            if v is None:
-                raise KeyError(f"op {op.type}: input var {n!r} not found")
-            shape = tuple(_DYN if dim == -1 else dim for dim in v.shape)
-            dtype = jnp.bfloat16 if v.dtype == "bfloat16" else np.dtype(v.dtype)
-            vals.append(jax.ShapeDtypeStruct(shape, dtype))
-        ins_struct[slot] = vals
+    def build_struct(sentinel):
+        has_dyn = False
+        ins_struct: Dict[str, List] = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                if n == EMPTY_VAR:
+                    vals.append(None)
+                    continue
+                v = block.find_var_recursive(n)
+                if v is None:
+                    raise KeyError(f"op {op.type}: input var {n!r} not found")
+                if any(dim == -1 for dim in v.shape):
+                    has_dyn = True
+                shape = tuple(sentinel if dim == -1 else dim
+                              for dim in v.shape)
+                dtype = (jnp.bfloat16 if v.dtype == "bfloat16"
+                         else np.dtype(v.dtype))
+                vals.append(jax.ShapeDtypeStruct(shape, dtype))
+            ins_struct[slot] = vals
+        return ins_struct, has_dyn
 
-    ctx = LowerCtx(op.attrs, abstract=True)
-    try:
-        outs = jax.eval_shape(lambda ins: d.lower(ctx, ins), ins_struct)
-    except Exception as e:
-        raise RuntimeError(
-            f"shape inference failed for op {op.type!r} "
-            f"(inputs: { {s: [None if v is None else (v.shape, str(v.dtype)) for v in vs] for s, vs in ins_struct.items()} }): {e}"
-        ) from e
+    def run(ins_struct):
+        ctx = LowerCtx(op.attrs, abstract=True)
+        try:
+            return jax.eval_shape(lambda ins: d.lower(ctx, ins), ins_struct)
+        except Exception as e:
+            raise RuntimeError(
+                f"shape inference failed for op {op.type!r} "
+                f"(inputs: { {s: [None if v is None else (v.shape, str(v.dtype)) for v in vs] for s, vs in ins_struct.items()} }): {e}"
+            ) from e
+
+    ins1, has_dyn = build_struct(_DYN)
+    outs = run(ins1)
+    # provenance by differencing: rerun with a second sentinel; dims that
+    # move are batch-derived -> -1. No collision for real dims that merely
+    # equal a multiple of the sentinel.
+    outs2 = run(build_struct(_DYN2)[0]) if has_dyn else outs
 
     for slot, names in op.outputs.items():
         structs = outs.get(slot, [])
+        structs2 = outs2.get(slot, [])
         for i, n in enumerate(names):
             if i >= len(structs) or n == EMPTY_VAR or structs[i] is None:
                 continue
-            st = structs[i]
-            shape = tuple(-1 if (dim == _DYN or (dim and dim % _DYN == 0)) else dim
-                          for dim in st.shape)
-            dtype = "bfloat16" if str(st.dtype) == "bfloat16" else np.dtype(st.dtype).name
+            st, st2 = structs[i], structs2[i]
+            shape = tuple(-1 if d1 != d2 else d1
+                          for d1, d2 in zip(st.shape, st2.shape))
+            dtype = ("bfloat16" if str(st.dtype) == "bfloat16"
+                     else np.dtype(st.dtype).name)
             existing = block.find_var_recursive(n)
             if existing is not None and not existing.is_data:
                 existing.shape = shape

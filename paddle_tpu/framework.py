@@ -378,6 +378,9 @@ class Block:
         inputs = _normalize_io(inputs)
         outputs = _normalize_io(outputs)
         op = Operator(self, type, inputs, outputs, attrs)
+        dev = _tls.op_device
+        if dev is not None and "op_device" not in op.attrs:
+            op.attrs["op_device"] = dev
         self.ops.append(op)
         self.program._bump()
         if infer_shape:
@@ -612,9 +615,36 @@ class _TLS(threading.local):
         self.main_program = Program()
         self.startup_program = Program()
         self.startup_program._is_startup = True
+        self.op_device = None
 
 
 _tls = _TLS()
+
+
+class device_guard:
+    """``with device_guard("gpu:0"):`` (reference framework.py device_guard)
+    tags the ops built inside with an ``op_device`` attr. On TPU there is no
+    per-op device placement -- XLA owns scheduling -- but the tags carry the
+    reference's pipeline-stage annotations: PipelineOptimizer's microbatch
+    rewrite keeps them, and they document stage intent for the explicit GPipe
+    path (parallel/pipeline.py). Accepts the reference's "cpu"/"gpu:N"
+    strings or "stage:N"."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        self.old = _tls.op_device
+        _tls.op_device = self.device
+        return self
+
+    def __exit__(self, *exc):
+        _tls.op_device = self.old
+        return False
+
+
+def current_op_device():
+    return _tls.op_device
 
 
 def default_main_program() -> Program:

@@ -83,7 +83,7 @@ def beam_search(ctx, ins):
     flat = cand.reshape(B, K * V)
     top_scores, top_idx = jax.lax.top_k(flat, K)                 # [B,K]
     parent = (top_idx // V).astype("int32")
-    token = (top_idx % V).astype(pre_scores.dtype).astype("int32")
+    token = (top_idx % V).astype("int32")
     par_finished = jnp.take_along_axis(finished, parent, axis=1)
     new_finished = jnp.logical_or(par_finished, token == end_id)
     return {"SelectedIds": [token.astype("int64")],

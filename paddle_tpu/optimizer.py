@@ -541,13 +541,44 @@ def _rewrite_microbatch_scan(program: Program, loss, params_grads, M):
     fwd_bwd_ops = list(block.ops)
     block.ops = []
 
-    # data vars the step consumes (is_data) become scanned sequences
+    # data vars the step consumes (is_data) become scanned sequences. Only
+    # TOP-LEVEL op inputs can be sliced: the executor's block_runner resolves
+    # nested-block names through the top-level env, so a feed read inside a
+    # sub-block WITHOUT being lifted into the enclosing op's inputs (the DSL
+    # lifts reads; hand-wired blocks may not) would silently see the full
+    # batch every microbatch -- refuse instead of corrupting gradients.
     data_names = []
     for op in fwd_bwd_ops:
         for n in op.input_arg_names():
             v = block.find_var_recursive(n)
             if v is not None and v.is_data and n not in data_names:
                 data_names.append(n)
+
+    def check_nested(ops, seen_blocks):
+        for op in ops:
+            for a in ("sub_block", "else_block"):
+                si = op.attr(a, -1)
+                if not (isinstance(si, int) and 0 <= si < len(program.blocks)
+                        and si not in seen_blocks):
+                    continue
+                seen_blocks.add(si)
+                sub_ops = program.blocks[si].ops
+                local = set(program.blocks[si].vars)
+                for sop in sub_ops:
+                    for n in sop.input_arg_names():
+                        v = block.find_var_recursive(n)
+                        if (v is not None and v.is_data and n not in local
+                                and n not in data_names):
+                            raise ValueError(
+                                f"PipelineOptimizer: feed var {n!r} is read "
+                                f"inside sub-block {si} but is not an input "
+                                f"of the enclosing control-flow op, so the "
+                                f"microbatch slice cannot reach it; declare "
+                                f"it in the op's inputs (the While/Scan DSL "
+                                f"does this automatically)")
+                check_nested(sub_ops, seen_blocks)
+
+    check_nested(fwd_bwd_ops, set())
 
     sub = program._create_block(parent_idx=0)
     sub.ops = fwd_bwd_ops
