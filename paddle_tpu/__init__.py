@@ -18,6 +18,10 @@ from .core import registry  # noqa: F401
 from . import layers  # noqa: F401
 from . import nets  # noqa: F401
 from . import dataset  # noqa: F401
+from . import fleet  # noqa: F401
+from . import inference  # noqa: F401
+from .dataset_factory import (DatasetFactory, InMemoryDataset,  # noqa
+                              QueueDataset)
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

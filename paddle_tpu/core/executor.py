@@ -336,6 +336,50 @@ class Executor:
     def close(self):
         self._cache.clear()
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Run one epoch over a Dataset (reference executor.py:920
+        train_from_dataset, which spun up C++ device-worker threads; here the
+        dataset yields host batches into the standard jitted step loop --
+        thread parallelism is XLA's async dispatch)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset (use "
+                             "fluid.DatasetFactory().create_dataset(...))")
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [v.name if isinstance(v, Variable) else
+                                    str(v) for v in fetch_list]
+        last = None
+        for i, feed in enumerate(dataset._iter_batches()):
+            vals = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            last = vals
+            if debug and fetch_list and i % max(print_period, 1) == 0:
+                msg = ", ".join(f"{n}={np.asarray(v).reshape(-1)[0]:.6g}"
+                                for n, v in zip(fetch_info, vals))
+                print(f"[train_from_dataset] batch {i}: {msg}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Reference executor.py:1012: same loop, eval-style (fetch-pruned so
+        optimizer ops do not run -- which is why fetch_list is required: with
+        nothing to prune toward, the full program incl. optimizer updates
+        would execute)."""
+        if dataset is None:
+            raise ValueError("infer_from_dataset needs a dataset")
+        if not fetch_list:
+            raise ValueError(
+                "infer_from_dataset needs a non-empty fetch_list: inference "
+                "prunes the program to the fetches; without them the full "
+                "program (including any optimizer ops) would run")
+        outs = []
+        for feed in dataset._iter_batches():
+            outs.append(self.run(program, feed=feed, fetch_list=fetch_list,
+                                 scope=scope, use_prune=True))
+        return outs
+
     # -- internals ---------------------------------------------------------------------
     def _state_names(self, program: Program, feed: dict, fetch_names=()):
         """Persistable vars read (state_in) / written (state_out) by the program."""

@@ -1,0 +1,184 @@
+"""Dataset facade: InMemoryDataset / QueueDataset + DatasetFactory.
+
+Reference: python/paddle/fluid/dataset.py (DatasetFactory:30,
+InMemoryDataset:432 with load_into_memory/local_shuffle/global_shuffle,
+QueueDataset:700) backed by the C++ MultiSlotDataset + DataFeed pipeline
+(framework/data_set.h:88-108, a multi-threaded file-parsing service feeding
+Hogwild workers).
+
+TPU-native: the C++ service collapses into host-side numpy. Files are parsed
+on load (text lines -> per-var columns), shuffles are host permutations --
+``global_shuffle`` seeds identically on every host and each host keeps its
+row stripe, which IS the reference's cross-trainer shuffle without the RPC
+shuffle service. ``Executor.train_from_dataset`` then drives the standard
+executor loop over the materialized batches.
+
+Line format (the reference's MultiSlot text format, simplified): one sample
+per line, slots separated by ``;``, values space-separated within a slot,
+ordered as ``set_use_var``. Override with ``set_parse_fn(line) -> tuple``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.use_vars = []
+        self.filelist: List[str] = []
+        self.thread_num = 1
+        self.drop_last = False
+        self._parse_fn: Optional[Callable] = None
+        self._samples: Optional[List[tuple]] = None
+        self._stripe = None      # (rank, world) view set by global_shuffle
+        self._epoch_seed = 0
+
+    # -- reference config surface ------------------------------------------------------
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)   # parity; parsing is vectorized
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_pipe_command(self, pipe_command):
+        import warnings
+        warnings.warn("paddle_tpu Dataset: pipe_command (a subprocess parser) "
+                      "is replaced by set_parse_fn(line)->tuple", UserWarning)
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        raise NotImplementedError("HDFS IO: mount the data locally; "
+                                  "SCOPE.md PS/CTR row")
+
+    def set_parse_fn(self, fn):
+        """TPU extension: fn(line:str) -> tuple of arrays/scalars per use_var."""
+        self._parse_fn = fn
+
+    # -- parsing -----------------------------------------------------------------------
+    def _parse_line(self, line):
+        if self._parse_fn is not None:
+            return tuple(self._parse_fn(line))
+        slots = line.strip().split(";")
+        if len(slots) != len(self.use_vars):
+            raise ValueError(
+                f"line has {len(slots)} slots but set_use_var lists "
+                f"{len(self.use_vars)} vars (separate slots with ';' or use "
+                f"set_parse_fn)")
+        out = []
+        for s, v in zip(slots, self.use_vars):
+            dt = v.dtype if v.dtype != "bfloat16" else "float32"
+            vals = s.split()
+            out.append(np.asarray(vals, dtype=np.dtype(dt))
+                       if vals else np.zeros((0,), dt))
+        return tuple(out)
+
+    def _read_files(self):
+        samples = []
+        for path in self.filelist:
+            if not os.path.exists(path):
+                raise FileNotFoundError(f"dataset file {path!r} not found")
+            with open(path) as f:
+                for line in f:
+                    if line.strip():
+                        samples.append(self._parse_line(line))
+        return samples
+
+    # -- iteration (used by Executor.train_from_dataset) -------------------------------
+    def _iter_batches(self):
+        samples = self._samples if self._samples is not None \
+            else self._read_files()
+        if self._stripe is not None:
+            r, w = self._stripe
+            samples = samples[r::w]
+        names = [v.name for v in self.use_vars]
+        bs = self.batch_size
+        if not samples or (self.drop_last and len(samples) < bs):
+            import warnings
+            warnings.warn(
+                f"Dataset yields no batches: {len(samples)} samples on this "
+                f"host vs batch_size={bs}", UserWarning)
+            return
+        for i in range(0, len(samples), bs):
+            chunk = samples[i:i + bs]
+            if len(chunk) < bs and self.drop_last:
+                return
+            cols = list(zip(*chunk))
+            yield {n: np.stack([np.asarray(x) for x in c])
+                   for n, c in zip(names, cols)}
+
+
+class InMemoryDataset(DatasetBase):
+    """Reference dataset.py:432."""
+
+    def load_into_memory(self):
+        self._samples = self._read_files()
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        return None
+
+    def release_memory(self):
+        self._samples = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples or [])
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._samples or [])
+
+    def local_shuffle(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+        rng = np.random.RandomState(self._epoch_seed)
+        self._epoch_seed += 1
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Cross-trainer shuffle: every host applies the IDENTICAL seeded
+        permutation to the full sample list, then keeps its row stripe --
+        equivalent to the reference's RPC shuffle service, no service.
+        The full sample list is kept; striping is a VIEW applied at batch
+        time, so repeated global_shuffle calls (one per epoch) reshuffle the
+        whole dataset instead of geometrically shrinking the stripe."""
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+        rng = np.random.RandomState(1000 + self._epoch_seed)
+        self._epoch_seed += 1
+        perm = rng.permutation(len(self._samples))
+        self._samples = [self._samples[i] for i in perm]
+        from .parallel import env as penv
+        w, r = penv.get_world_size(), penv.get_rank()
+        self._stripe = (r, w) if w > 1 else None
+
+
+class QueueDataset(DatasetBase):
+    """Reference dataset.py:700: streaming variant (no load_into_memory)."""
+
+    def local_shuffle(self):
+        raise ValueError("QueueDataset streams files; use InMemoryDataset "
+                         "for shuffling (reference raises the same)")
+
+    def global_shuffle(self, fleet=None):
+        raise ValueError("QueueDataset streams files; use InMemoryDataset")
+
+
+class DatasetFactory:
+    """Reference dataset.py:30."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

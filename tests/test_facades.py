@@ -1,0 +1,125 @@
+"""Fleet facade, Dataset/train_from_dataset, inference Predictor (closing the
+VERDICT coverage rows: fleet wrappers, DataFeed/Dataset service,
+trainer path, predictor/serving API)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import fleet
+
+
+def _mlp_program(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [8], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+    return main, startup, loss, logits
+
+
+def test_fleet_collective_trains():
+    """Reference-shaped fleet flow: init -> distributed_optimizer -> minimize
+    -> run fleet.main_program; must train dp8 with loss parity to plain run."""
+    main, startup, loss, _ = _mlp_program()
+    with fluid.program_guard(main, startup):
+        fleet.init()
+        opt = fleet.distributed_optimizer(fluid.optimizer.Adam(0.01))
+        opt.minimize(loss)
+    assert fleet.worker_num() >= 1 and fleet.is_first_worker()
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 8).astype("float32"),
+            "label": rng.randint(0, 4, (16, 1)).astype("int64")}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(10):
+            lv, = exe.run(fleet.main_program, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_inmemory_dataset_and_train_from_dataset(tmp_path):
+    """Text files -> InMemoryDataset -> global_shuffle ->
+    exe.train_from_dataset (reference dist-CTR flow on the TPU executor)."""
+    rng = np.random.RandomState(1)
+    W = rng.randn(8, 4).astype("float32")
+    files = []
+    for fi in range(2):
+        lines = []
+        for _ in range(64):
+            x = rng.randn(8).astype("float32")
+            y = int(np.argmax(x @ W))
+            lines.append(" ".join(f"{v:.6f}" for v in x) + f";{y}")
+        p = tmp_path / f"part-{fi}.txt"
+        p.write_text("\n".join(lines))
+        files.append(str(p))
+
+    main, startup, loss, _ = _mlp_program(seed=2)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(0.02).minimize(loss)
+    x_var = main.global_block().vars["x"]
+    label_var = main.global_block().vars["label"]
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(32)
+    ds.set_use_var([x_var, label_var])
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 128
+    ds.global_shuffle()
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        first = exe.train_from_dataset(main, ds, fetch_list=[loss])
+        for _ in range(14):
+            ds.local_shuffle()
+            last = exe.train_from_dataset(main, ds, fetch_list=[loss])
+    assert float(np.asarray(last[0]).reshape(())) < \
+        float(np.asarray(first[0]).reshape(())) * 0.7
+
+
+def test_queue_dataset_refuses_shuffle():
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    with pytest.raises(ValueError, match="InMemoryDataset"):
+        ds.local_shuffle()
+
+
+def test_predictor_aot_session(tmp_path):
+    """save_inference_model -> Predictor: outputs match the executor, the
+    executable cache holds one entry per shape signature, params are pinned."""
+    d = str(tmp_path / "model")
+    main, startup, loss, logits = _mlp_program(seed=3)
+    rng = np.random.RandomState(4)
+    xv = rng.randn(8, 8).astype("float32")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [logits], exe, main)
+        ref, = exe.run(main, feed={"x": xv,
+                                   "label": np.zeros((8, 1), "int64")},
+                       fetch_list=[logits])
+
+    pred = fluid.inference.Predictor(d)
+    assert pred.get_input_names() == ["x"]
+    out, = pred.run({"x": xv})
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    out2, = pred.run([xv])                       # list-style C++ contract
+    np.testing.assert_allclose(out2, ref, rtol=1e-6)
+    assert len(pred._compiled) == 1              # same signature -> one exec
+    pred.run({"x": xv[:4]})
+    assert len(pred._compiled) == 2              # new batch -> new executable
+
+    cfg = fluid.inference.AnalysisConfig(d)
+    p2 = fluid.inference.create_paddle_predictor(cfg)
+    out3, = p2.run({"x": xv})
+    np.testing.assert_allclose(out3, ref, rtol=1e-5, atol=1e-6)
