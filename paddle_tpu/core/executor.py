@@ -374,11 +374,20 @@ class Executor:
                 "infer_from_dataset needs a non-empty fetch_list: inference "
                 "prunes the program to the fetches; without them the full "
                 "program (including any optimizer ops) would run")
-        outs = []
-        for feed in dataset._iter_batches():
-            outs.append(self.run(program, feed=feed, fetch_list=fetch_list,
-                                 scope=scope, use_prune=True))
-        return outs
+        # like the reference, results are not accumulated (a full epoch of
+        # fetches is unbounded host memory); the last batch's values return
+        # for convenience, use debug/print_period to observe the stream
+        fetch_info = fetch_info or [v.name if isinstance(v, Variable) else
+                                    str(v) for v in fetch_list]
+        last = None
+        for i, feed in enumerate(dataset._iter_batches()):
+            last = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope, use_prune=True)
+            if debug and i % max(print_period, 1) == 0:
+                msg = ", ".join(f"{n}={np.asarray(v).reshape(-1)[0]:.6g}"
+                                for n, v in zip(fetch_info, last))
+                print(f"[infer_from_dataset] batch {i}: {msg}")
+        return last
 
     # -- internals ---------------------------------------------------------------------
     def _state_names(self, program: Program, feed: dict, fetch_names=()):

@@ -33,7 +33,8 @@ class DatasetBase:
         self.thread_num = 1
         self.drop_last = False
         self._parse_fn: Optional[Callable] = None
-        self._samples: Optional[List[tuple]] = None
+        self._samples = None     # row list of tuples OR columnar matrices
+        self._perm = None        # shuffle permutation (a view, not a copy)
         self._stripe = None      # (rank, world) view set by global_shuffle
         self._epoch_seed = 0
 
@@ -82,38 +83,103 @@ class DatasetBase:
         return tuple(out)
 
     def _read_files(self):
+        """Returns either columnar matrices (native C++ parse -- one
+        contiguous [N, width] array per slot, no per-row object churn) or a
+        row list of tuples (Python fallback). Both shapes are understood by
+        _iter_batches and the shuffles (which permute an index array)."""
+        col_parts: Optional[List[List[np.ndarray]]] = None
         samples = []
         for path in self.filelist:
             if not os.path.exists(path):
                 raise FileNotFoundError(f"dataset file {path!r} not found")
+            native = self._read_native(path)
+            if native is not None and not samples:
+                if col_parts is None:
+                    col_parts = [[] for _ in native]
+                for parts, c in zip(col_parts, native):
+                    parts.append(c)
+                continue
+            if native is not None:      # mixed native/python files: demote
+                samples.extend(zip(*[list(c) for c in native]))
+                continue
+            if col_parts is not None:   # demote earlier columnar reads
+                cols = [np.concatenate(p) for p in col_parts]
+                samples.extend(zip(*[list(c) for c in cols]))
+                col_parts = None
             with open(path) as f:
                 for line in f:
                     if line.strip():
                         samples.append(self._parse_line(line))
+        if col_parts is not None and not samples:
+            return [np.concatenate(p) for p in col_parts]
         return samples
 
+    def _read_native(self, path):
+        """Multithreaded C++ slot parser (native/fast_parser.cpp, the
+        data_feed.cc analog); None -> fall back to the Python line parser.
+        Only the default rectangular slot format qualifies, and integer
+        slots must round-trip float32 exactly (|v| < 2^24, integral) --
+        hashed CTR ids beyond that fall back to the exact Python parse."""
+        if self._parse_fn is not None or not self.use_vars:
+            return None
+        from . import native
+        if not native.available():
+            return None
+        try:
+            rows, cols = native.parse_slot_file(path, len(self.use_vars),
+                                                n_threads=self.thread_num)
+        except ValueError:
+            return None   # ragged/typed lines: Python parser handles or errors
+        typed = []
+        for c, v in zip(cols, self.use_vars):
+            dt = v.dtype if v.dtype != "bfloat16" else "float32"
+            if np.issubdtype(np.dtype(dt), np.integer):
+                if (np.abs(c) >= 2 ** 24).any() or (c != np.floor(c)).any():
+                    return None   # float32 can't represent these ids exactly
+                c = c.astype(np.dtype(dt))
+            elif dt != "float32":
+                c = c.astype(np.dtype(dt))
+            typed.append(c)
+        return typed
+
+    @staticmethod
+    def _is_columnar(samples):
+        return (isinstance(samples, list) and samples and
+                isinstance(samples[0], np.ndarray) and samples[0].ndim == 2)
+
     # -- iteration (used by Executor.train_from_dataset) -------------------------------
+    def _n_samples(self, samples):
+        return samples[0].shape[0] if self._is_columnar(samples) \
+            else len(samples)
+
     def _iter_batches(self):
         samples = self._samples if self._samples is not None \
             else self._read_files()
+        columnar = self._is_columnar(samples)
+        idx = self._perm if getattr(self, "_perm", None) is not None \
+            else np.arange(self._n_samples(samples))
         if self._stripe is not None:
             r, w = self._stripe
-            samples = samples[r::w]
+            idx = idx[r::w]
         names = [v.name for v in self.use_vars]
         bs = self.batch_size
-        if not samples or (self.drop_last and len(samples) < bs):
+        n = len(idx)
+        if n == 0 or (self.drop_last and n < bs):
             import warnings
             warnings.warn(
-                f"Dataset yields no batches: {len(samples)} samples on this "
+                f"Dataset yields no batches: {n} samples on this "
                 f"host vs batch_size={bs}", UserWarning)
             return
-        for i in range(0, len(samples), bs):
-            chunk = samples[i:i + bs]
-            if len(chunk) < bs and self.drop_last:
+        for i in range(0, n, bs):
+            take = idx[i:i + bs]
+            if len(take) < bs and self.drop_last:
                 return
-            cols = list(zip(*chunk))
-            yield {n: np.stack([np.asarray(x) for x in c])
-                   for n, c in zip(names, cols)}
+            if columnar:
+                yield {nm: c[take] for nm, c in zip(names, samples)}
+            else:
+                cols = list(zip(*[samples[j] for j in take]))
+                yield {nm: np.stack([np.asarray(x) for x in c])
+                       for nm, c in zip(names, cols)}
 
 
 class InMemoryDataset(DatasetBase):
@@ -130,33 +196,36 @@ class InMemoryDataset(DatasetBase):
 
     def release_memory(self):
         self._samples = None
+        self._perm = None
+        self._stripe = None
 
     def get_memory_data_size(self, fleet=None):
-        return len(self._samples or [])
+        return 0 if self._samples is None else self._n_samples(self._samples)
 
     def get_shuffle_data_size(self, fleet=None):
-        return len(self._samples or [])
+        return self.get_memory_data_size(fleet)
 
     def local_shuffle(self):
+        """Shuffles are index permutations -- the (possibly columnar) data
+        never moves, so native-parsed matrices stay contiguous."""
         if self._samples is None:
             raise RuntimeError("call load_into_memory() first")
         rng = np.random.RandomState(self._epoch_seed)
         self._epoch_seed += 1
-        rng.shuffle(self._samples)
+        self._perm = rng.permutation(self._n_samples(self._samples))
 
     def global_shuffle(self, fleet=None, thread_num=12):
         """Cross-trainer shuffle: every host applies the IDENTICAL seeded
-        permutation to the full sample list, then keeps its row stripe --
-        equivalent to the reference's RPC shuffle service, no service.
-        The full sample list is kept; striping is a VIEW applied at batch
-        time, so repeated global_shuffle calls (one per epoch) reshuffle the
-        whole dataset instead of geometrically shrinking the stripe."""
+        permutation, then keeps its row stripe -- equivalent to the
+        reference's RPC shuffle service, no service. Both the permutation
+        and the stripe are VIEWS applied at batch time, so repeated calls
+        (one per epoch) reshuffle the whole dataset instead of
+        geometrically shrinking the stripe."""
         if self._samples is None:
             raise RuntimeError("call load_into_memory() first")
         rng = np.random.RandomState(1000 + self._epoch_seed)
         self._epoch_seed += 1
-        perm = rng.permutation(len(self._samples))
-        self._samples = [self._samples[i] for i in perm]
+        self._perm = rng.permutation(self._n_samples(self._samples))
         from .parallel import env as penv
         w, r = penv.get_world_size(), penv.get_rank()
         self._stripe = (r, w) if w > 1 else None

@@ -63,15 +63,15 @@ class Predictor:
         self.program: Program = prog
         self.feed_names: List[str] = list(feeds)
         self.fetch_names: List[str] = list(fetches)
-        # pin parameters on device once (the C++ predictor's pinned buffers)
-        self._state = {n: jax.device_put(self._scope.find_var(n))
-                       for n in self._scope.var_names()
-                       if self._scope.find_var(n) is not None}
-        # weights read only inside control-flow sub-blocks count too (the
-        # same traversal Executor._state_names does)
+        # pin parameters on device once (the C++ predictor's pinned
+        # buffers); weights read only inside control-flow sub-blocks count
+        # too (the same traversal Executor._state_names does), and only the
+        # needed set is transferred
         needed = {n for blk in self.program.blocks
                   for op in blk.ops for n in op.input_arg_names()}
-        self._state = {n: v for n, v in self._state.items() if n in needed}
+        self._state = {n: jax.device_put(self._scope.find_var(n))
+                       for n in self._scope.var_names()
+                       if n in needed and self._scope.find_var(n) is not None}
         self._compiled = {}
 
     # -- compilation -------------------------------------------------------------------

@@ -1,0 +1,91 @@
+"""Native runtime components, loaded via ctypes (no pybind11 in this stack).
+
+The compute path is JAX/XLA/Pallas; these are the host-runtime pieces the
+reference implements in C++ (data_feed.cc parsing threads). Each component
+compiles on first use with g++ if the prebuilt .so is missing and degrades
+to a documented pure-Python fallback when no toolchain exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB = None
+_LIB_TRIED = False
+
+
+def _load():
+    global _LIB, _LIB_TRIED
+    with _LOCK:
+        if _LIB_TRIED:
+            return _LIB
+        _LIB_TRIED = True
+        so = os.path.join(_DIR, "libfast_parser.so")
+        src = os.path.join(_DIR, "fast_parser.cpp")
+        if not os.path.exists(so) or (os.path.exists(src) and
+                                      os.path.getmtime(src) >
+                                      os.path.getmtime(so)):
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", "-o", so, src],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.parse_slot_file.restype = ctypes.c_int64
+        lib.parse_slot_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_slot_file(path: str, n_slots: int, n_threads: int = 0):
+    """Parse a rectangular slot-text file natively.
+
+    Returns (rows: int, columns: list of float32 arrays [rows, width_s]) or
+    None when the native library is unavailable (caller falls back to the
+    Python parser).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    fsize = os.path.getsize(path)
+    # every float needs >=2 bytes of text ("0 "), so fsize/2 bounds the count
+    cap = max(fsize // 2 + n_slots, 64)
+    out = np.empty(cap, np.float32)
+    widths = np.zeros(n_slots, np.int64)
+    rows = lib.parse_slot_file(
+        path.encode(), n_slots,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), cap,
+        widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n_threads)
+    if rows < 0:
+        raise ValueError(
+            {-1: f"cannot open {path!r}",
+             -2: f"{path!r}: ragged line (slots must be fixed-width, "
+                 f"{n_slots} ';'-separated slots per line)",
+             -3: f"{path!r}: parser buffer overflow",
+             -4: f"{path!r}: malformed float"}.get(int(rows),
+                                                   f"error {rows}"))
+    stride = int(widths.sum())
+    mat = out[:rows * stride].reshape(int(rows), stride)
+    cols, off = [], 0
+    for w in widths:
+        cols.append(np.ascontiguousarray(mat[:, off:off + int(w)]))
+        off += int(w)
+    return int(rows), cols

@@ -123,3 +123,38 @@ def test_predictor_aot_session(tmp_path):
     p2 = fluid.inference.create_paddle_predictor(cfg)
     out3, = p2.run({"x": xv})
     np.testing.assert_allclose(out3, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_native_parser_matches_python(tmp_path):
+    """The C++ slot parser (native/fast_parser.cpp) must agree with the
+    Python fallback exactly and actually be in use when available."""
+    from paddle_tpu import native
+    rng = np.random.RandomState(7)
+    lines = []
+    data = rng.randn(200, 9).astype("float32")
+    for row in data:
+        lines.append(" ".join(f"{v:.6f}" for v in row[:8]) +
+                     f";{int(abs(row[8]) * 3) % 4}")
+    p = tmp_path / "native.txt"
+    p.write_text("\n".join(lines))
+
+    main, startup, loss, _ = _mlp_program(seed=9)
+    x_var = main.global_block().vars["x"]
+    label_var = main.global_block().vars["label"]
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(50)
+    ds.set_use_var([x_var, label_var])
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    batches = list(ds._iter_batches())
+    assert len(batches) == 4
+    np.testing.assert_allclose(batches[0]["x"][0], data[0, :8], rtol=1e-5, atol=1e-5)
+    assert batches[0]["label"].dtype == np.int64
+
+    if native.available():
+        rows, cols = native.parse_slot_file(str(p), 2)
+        assert rows == 200 and cols[0].shape == (200, 8)
+        np.testing.assert_allclose(cols[0], data[:, :8], rtol=1e-5, atol=1e-5)
+    else:
+        pytest.skip("no g++ toolchain; python fallback covered above")
