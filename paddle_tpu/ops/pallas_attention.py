@@ -12,8 +12,11 @@ per step round-tripped, ~3x that in backward).
 Design:
   * Per the registry's kernel-choice contract (core/registry.py:10), this is an
     *alternative lowering* for the `fused_attention` op: `impl=auto` picks the
-    Pallas kernel on TPU (interpret-mode on CPU so tests exercise the same code
-    path), and the composed jnp lowering otherwise or for unsupported shapes.
+    Pallas kernel on TPU from S >= AUTO_PALLAS_MIN_S up (XLA's own fusion wins
+    below; see the measured crossover at the constant), the ring schedule
+    under an sp>1 mesh, and the composed jnp lowering otherwise or for
+    unsupported shapes. `impl='pallas'` forces the kernel at any supported S
+    (interpret-mode on CPU so tests exercise the same code path).
   * Whole K/V rows for one (batch, head) are staged in VMEM (S*D*2 bytes each --
     fits to S~8k); Q is blocked at BLK_Q rows. Softmax is computed in f32 in
     VMEM. Matmuls hit the MXU with preferred_element_type=f32.
@@ -34,6 +37,14 @@ import math
 from ..core.registry import register
 
 BLK_Q = 128
+
+# 'auto' uses the Pallas kernel only from this sequence length up: measured
+# on TPU v5e (bf16, H=12 D=64, B*S fixed at 16k tokens), XLA's own fused
+# attention wins below it (6.1 vs 7.3 ms at S=128) and flash wins above
+# (7.4 vs 10.0 ms at S=2048) -- the online-softmax tiling pays off once the
+# S x S score tile stops fitting cache-friendly shapes. impl='pallas' forces
+# the kernel regardless.
+AUTO_PALLAS_MIN_S = 1024
 
 
 def _pl():
@@ -286,13 +297,16 @@ def fused_attention(ctx, ins):
 
     Inputs: Q/K/V [B, heads, S, D]; optional Bias [B, 1, 1, S] additive (already
     -inf-masked). Attrs: scale (default 1/sqrt(D)), dropout_prob, causal,
-    is_test, impl ('auto' | 'pallas' | 'ring' | 'composed').
+    is_test, impl ('auto' | 'pallas' | 'ring' | 'ulysses' | 'composed').
 
     Kernel choice: under a GSPMD jit whose mesh has an "sp" axis >1 (sequence
     parallelism), 'auto' opens the ring-attention shard_map island
     (parallel/ring_attention.py) so the sequence dim STAYS partitioned --
-    GSPMD alone would all-gather K/V to every device. Otherwise 'auto' is the
-    Pallas flash kernel on TPU-supported shapes, else the composed jnp path.
+    GSPMD alone would all-gather K/V to every device; 'ulysses' instead does
+    the all-to-all head-scatter schedule (parallel/ulysses.py, needs heads
+    divisible by sp). Otherwise 'auto' is the Pallas flash kernel on
+    TPU-supported shapes from S >= AUTO_PALLAS_MIN_S (below that XLA's own
+    fusion is measurably faster), else the composed jnp path.
     """
     import jax
     import jax.numpy as jnp
@@ -323,6 +337,17 @@ def fused_attention(ctx, ins):
             f"fused_attention impl='ring' needs a GSPMD mesh with sp>1 "
             f"dividing S and a [B,1,1,S] bias; got sp={sp_n}, S={S}, "
             f"bias={None if bias is None else bias.shape}")
+    if impl == "ulysses":
+        if not (ring_ok and H % sp_n == 0):
+            raise ValueError(
+                f"fused_attention impl='ulysses' needs a GSPMD mesh with "
+                f"sp>1 dividing both S and heads, and a [B,1,1,S] bias; got "
+                f"sp={sp_n}, S={S}, H={H}, "
+                f"bias={None if bias is None else bias.shape}")
+        from ..parallel import ulysses as _uly
+        seed = jax.random.randint(ctx.rng(), (), 0, 2**31 - 1, jnp.int32)
+        return {"Out": [_uly.ulysses_attention(
+            q, k, v, bias, float(scale), float(dropout), causal, seed, gm)]}
     if ring_ok and impl in ("auto", "ring"):
         from ..parallel import ring_attention as _ring
         seed = jax.random.randint(ctx.rng(), (), 0, 2**31 - 1, jnp.int32)
@@ -338,8 +363,8 @@ def fused_attention(ctx, ins):
             f"bias={bias_shape}, dropout={dropout}, backend_tpu={is_tpu}. "
             f"Use impl='auto' to fall back to the composed lowering.")
     use_pallas = impl == "pallas" or (
-        impl == "auto" and supports_pallas(B, H, S, D, bias_shape, dropout,
-                                           is_tpu))
+        impl == "auto" and S >= AUTO_PALLAS_MIN_S and
+        supports_pallas(B, H, S, D, bias_shape, dropout, is_tpu))
     if use_pallas:
         seed = jax.random.randint(ctx.rng(), (), 0, 2**31 - 1, jnp.int32)
         out = _flash(q, k, v, bias, seed, float(scale), float(dropout), causal,

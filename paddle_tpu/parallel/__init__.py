@@ -9,5 +9,8 @@ schedules that GSPMD cannot infer (pipeline.py), and process launching
 (launch.py).
 """
 from .env import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
-                  local_device_count, global_mesh, ParallelEnv)
+                  local_device_count, global_mesh, ParallelEnv, barrier,
+                  monitored_run)
 from .pipeline import pipeline_spmd  # noqa: F401
+from . import ring_attention  # noqa: F401  (module: .ring_attention(...))
+from . import ulysses  # noqa: F401         (module: .ulysses_attention(...))
