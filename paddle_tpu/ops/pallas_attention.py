@@ -338,11 +338,14 @@ def fused_attention(ctx, ins):
             f"dividing S and a [B,1,1,S] bias; got sp={sp_n}, S={S}, "
             f"bias={None if bias is None else bias.shape}")
     if impl == "ulysses":
-        if not (ring_ok and H % sp_n == 0):
+        mp_n = gm.shape.get("mp", 1) if gm is not None else 1
+        h_local = H // mp_n if mp_n > 1 and H % mp_n == 0 else H
+        if not (ring_ok and h_local % sp_n == 0):
             raise ValueError(
                 f"fused_attention impl='ulysses' needs a GSPMD mesh with "
-                f"sp>1 dividing both S and heads, and a [B,1,1,S] bias; got "
-                f"sp={sp_n}, S={S}, H={H}, "
+                f"sp>1 dividing S and the per-mp-shard head count, and a "
+                f"[B,1,1,S] bias; got sp={sp_n}, S={S}, H={H} "
+                f"({h_local} heads per mp shard), "
                 f"bias={None if bias is None else bias.shape}")
         from ..parallel import ulysses as _uly
         seed = jax.random.randint(ctx.rng(), (), 0, 2**31 - 1, jnp.int32)

@@ -7,15 +7,7 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu.ops.pallas_attention import composed_attention
 from paddle_tpu.parallel import ulysses as uly_mod
-
-
-def _mesh(shape):
-    import jax
-    import numpy as onp
-    from jax.sharding import Mesh
-    sizes = list(shape.values())
-    n = int(onp.prod(sizes))
-    return Mesh(onp.array(jax.devices()[:n]).reshape(sizes), tuple(shape))
+from tests.test_ring_attention import _mesh, _train  # shared SP test helpers
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -105,21 +97,6 @@ def _attn_program(seed, impl="ulysses"):
     return main, startup, loss
 
 
-def _train(program_for_run, startup, loss, steps=4):
-    rng = np.random.RandomState(7)
-    exe = fluid.Executor()
-    losses = []
-    with fluid.scope_guard(fluid.Scope()):
-        exe.run(startup)
-        for _ in range(steps):
-            x = rng.randn(4, 32, 16).astype("float32")
-            mask = np.ones((4, 32), "float32")
-            lv, = exe.run(program_for_run, feed={"x": x, "mask": mask},
-                          fetch_list=[loss])
-            losses.append(float(np.asarray(lv).reshape(())))
-    return losses
-
-
 def test_program_impl_ulysses_matches_single():
     """Full train steps under dp2 x sp4 with impl='ulysses' must match the
     single-device run and actually take the all-to-all path."""
@@ -143,3 +120,20 @@ def test_ulysses_requires_divisible_heads():
     q = jnp.zeros((2, 6, 32, 8))   # H=6 not divisible by sp=4
     with pytest.raises(ValueError, match="heads"):
         ulysses.ulysses_attention(q, q, q, None, 1.0, 0.0, False, 0, mesh)
+
+
+def test_ulysses_dropout_path_runs():
+    """dropout>0 through the all-to-all kernel: finite, different from the
+    no-dropout output, deterministic for a fixed seed."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    B, H, S, D = 2, 4, 32, 8
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    mesh = _mesh({"sp": 4})
+    a1 = uly_mod.ulysses_attention(q, q, q, None, 0.35, 0.5, False, 7, mesh)
+    a2 = uly_mod.ulysses_attention(q, q, q, None, 0.35, 0.5, False, 7, mesh)
+    a0 = uly_mod.ulysses_attention(q, q, q, None, 0.35, 0.0, False, 7, mesh)
+    assert np.isfinite(np.asarray(a1)).all()
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(a0))
