@@ -7,7 +7,9 @@ from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box"]
+__all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
+           "multiclass_nms", "roi_align", "roi_pool", "anchor_generator",
+           "box_clip", "bipartite_match", "target_assign", "ssd_loss"]
 
 
 def _out(helper, dtype="float32", stop_gradient=False):
@@ -69,3 +71,156 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
                             "downsample_ratio": downsample_ratio})
     blk = helper.main_program.current_block()
     return blk.var(boxes.name), blk.var(scores.name)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_rois_num=True):
+    """Reference nn/detection.py:multiclass_nms. TPU-native output: fixed
+    [N, keep_top_k, 6] (label, score, x1, y1, x2, y2) with label=-1 padding
+    + per-image kept counts (the LoD output becomes padded + counts)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = _out(helper, bboxes.dtype, stop_gradient=True)
+    num = _out(helper, "int64", stop_gradient=True)
+    helper.append_op("multiclass_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out], "NmsRoisNum": [num]},
+                     attrs={"score_threshold": float(score_threshold),
+                            "nms_top_k": int(nms_top_k),
+                            "keep_top_k": int(keep_top_k),
+                            "nms_threshold": float(nms_threshold),
+                            "normalized": bool(normalized),
+                            "nms_eta": float(nms_eta),
+                            "background_label": int(background_label)})
+    blk = helper.main_program.current_block()
+    if return_rois_num:
+        return blk.var(out.name), blk.var(num.name)
+    return blk.var(out.name)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None, name=None):
+    """Reference detection roi_align. rois_num [N]: per-image ROI counts."""
+    helper = LayerHelper("roi_align", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op("roi_align", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "spatial_scale": float(spatial_scale),
+                            "sampling_ratio": int(sampling_ratio)})
+    return helper.main_program.current_block().var(out.name)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_num=None, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op("roi_pool", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "spatial_scale": float(spatial_scale)})
+    return helper.main_program.current_block().var(out.name)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = _out(helper, input.dtype, stop_gradient=True)
+    variances = _out(helper, input.dtype, stop_gradient=True)
+    helper.append_op("anchor_generator", inputs={"Input": [input]},
+                     outputs={"Anchors": [anchors], "Variances": [variances]},
+                     attrs={"anchor_sizes": [float(s) for s in
+                                             (anchor_sizes or [64.0])],
+                            "aspect_ratios": [float(r) for r in
+                                              (aspect_ratios or [1.0])],
+                            "variances": [float(v) for v in variance],
+                            "stride": [float(s) for s in (stride or [16, 16])],
+                            "offset": float(offset)})
+    blk = helper.main_program.current_block()
+    return blk.var(anchors.name), blk.var(variances.name)
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op("box_clip", inputs={"Input": [input],
+                                         "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    return helper.main_program.current_block().var(out.name)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = _out(helper, "int32", stop_gradient=True)
+    dist = _out(helper, dist_matrix.dtype, stop_gradient=True)
+    helper.append_op("bipartite_match", inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [idx],
+                              "ColToRowMatchDist": [dist]},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": float(dist_threshold)})
+    blk = helper.main_program.current_block()
+    return blk.var(idx.name), blk.var(dist.name)
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = _out(helper, input.dtype, stop_gradient=True)
+    w = _out(helper, input.dtype, stop_gradient=True)
+    helper.append_op("target_assign",
+                     inputs={"X": [input],
+                             "MatchIndices": [matched_indices]},
+                     outputs={"Out": [out], "OutWeight": [w]},
+                     attrs={"mismatch_value": float(mismatch_value)})
+    blk = helper.main_program.current_block()
+    return blk.var(out.name), blk.var(w.name)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """Reference detection.py:ssd_loss composite, padded+counts form:
+    gt_box [G, 4], gt_label [G, 1] for a single image (batch the program or
+    vmap for multi-image). Matches priors to ground truth (bipartite +
+    per-prediction), encodes regression targets, smooth-L1 + softmax losses
+    with matched-position weighting (hard negative mining simplified to the
+    weighting scheme -- documented deviation)."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+    iou = iou_similarity(gt_box, prior_box)                   # [G, M]
+    match_idx, match_dist = bipartite_match(iou, match_type,
+                                            overlap_threshold)
+    # location loss on matched priors; unmatched rows take the prior itself
+    # as the (zero-residual) target -- encoding a zero box would log(0)->NaN
+    # and poison the whole graph even though its weight is zero
+    loc_target, loc_w = target_assign(gt_box, match_idx)      # [M, 4]
+    safe_target = _nn.elementwise_add(
+        _nn.elementwise_mul(loc_target, loc_w),
+        _nn.elementwise_mul(prior_box,
+                            _nn.scale(loc_w, -1.0, bias=1.0)))
+    enc = box_coder(prior_box, prior_box_var, safe_target)    # encode
+    loc_l = _nn.smooth_l1(location, enc)
+    loc_l = _nn.reduce_sum(_nn.elementwise_mul(loc_l, loc_w))
+    # classification: matched priors take the gt label, rest background
+    lbl_target, _ = target_assign(
+        _tensor.cast(gt_label, "float32"), match_idx,
+        mismatch_value=float(background_label))
+    conf_l = _nn.softmax_with_cross_entropy(
+        confidence, _tensor.cast(lbl_target, "int64"))
+    conf_l = _nn.reduce_sum(conf_l)
+    total = _nn.elementwise_add(_nn.scale(loc_l, float(loc_loss_weight)),
+                                _nn.scale(conf_l, float(conf_loss_weight)))
+    if normalize:
+        denom = _nn.scale(_nn.reduce_sum(loc_w), 0.25, bias=1e-6)
+        total = _nn.elementwise_div(total, denom)
+    return total

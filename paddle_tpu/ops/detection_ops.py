@@ -15,18 +15,22 @@ def _jnp():
     return jnp
 
 
+def _iou_matrix(a, b, norm=0.0):
+    """[N,4] x [M,4] xyxy -> [N,M] IoU. norm=1.0 applies the reference's
+    pixel-coordinate +1 convention (normalized=False boxes)."""
+    jnp = _jnp()
+    area = lambda z: (jnp.maximum(z[:, 2] - z[:, 0] + norm, 0) *
+                      jnp.maximum(z[:, 3] - z[:, 1] + norm, 0))
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + norm, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area(a)[:, None] + area(b)[None, :] - inter + 1e-10)
+
+
 @register("iou_similarity", grad=None)
 def iou_similarity(ctx, ins):
-    jnp = _jnp()
-    x, y = ins["X"][0], ins["Y"][0]  # [N,4], [M,4] xyxy
-    area = lambda b: jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
-        b[:, 3] - b[:, 1], 0)
-    ax, ay = area(x), area(y)
-    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
-    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
-    inter = wh[..., 0] * wh[..., 1]
-    return {"Out": [inter / (ax[:, None] + ay[None, :] - inter + 1e-10)]}
+    return {"Out": [_iou_matrix(ins["X"][0], ins["Y"][0])]}
 
 
 @register("box_coder", grad=None)
@@ -137,3 +141,320 @@ def yolo_box(ctx, ins):
     scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2).reshape(
         n, -1, class_num)
     return {"Boxes": [boxes], "Scores": [scores]}
+
+
+def _roi_batch_index(jnp, rois_num, R):
+    """RoisNum [N] per-image counts (the reference's LoD replacement) ->
+    per-ROI image index [R], static shapes via searchsorted."""
+    if rois_num is None:
+        return jnp.zeros((R,), "int32")
+    counts = rois_num.reshape(-1).astype("int32")
+    ends = jnp.cumsum(counts)
+    return jnp.searchsorted(ends, jnp.arange(R, dtype="int32"),
+                            side="right").astype("int32")
+
+
+def _nms_keep(boxes, scores, iou_threshold, max_out):
+    """Fixed-size greedy NMS on score-sorted candidates.
+
+    Returns (idx [max_out] int32 into `boxes`, valid [max_out] bool).
+    The reference's multiclass_nms emits a ragged LoD tensor
+    (detection/multiclass_nms_op.cc); XLA needs static shapes, so the output
+    is padded + a validity mask -- the standard TPU NMS formulation: sort by
+    score, then a lax.scan sweep keeps a box iff it does not overlap an
+    already-kept higher-scoring box.
+    """
+    import jax
+    jnp = _jnp()
+    K = min(int(max_out), boxes.shape[0])
+    top_scores, order = jax.lax.top_k(scores, K)
+    cand = boxes[order]                                  # [K, 4]
+    iou = _iou_matrix(cand, cand)                        # [K, K]
+
+    def step(kept, i):
+        # kept: [K] bool of already-kept candidates (all lower index = higher
+        # score). candidate i survives iff no kept j<i overlaps it.
+        over = (iou[i] > iou_threshold) & kept & \
+            (jnp.arange(K) < i)
+        keep_i = ~over.any()
+        return kept.at[i].set(keep_i), keep_i
+
+    kept0 = jnp.zeros((K,), bool)
+    _, keep = jax.lax.scan(step, kept0, jnp.arange(K))
+    return order, keep & (top_scores > -jnp.inf)
+
+
+@register("multiclass_nms", grad=None, nondiff_inputs=("BBoxes", "Scores"))
+def multiclass_nms(ctx, ins):
+    """Per-class NMS + cross-class top-k (multiclass_nms_op.cc).
+
+    BBoxes [N, M, 4]; Scores [N, C, M]. Out: [N, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2) padded with label=-1 rows + OutNum [N].
+    The per-class sweep is one vmap over the class axis (the background
+    class is masked to -inf, not skipped, so every class traces the same
+    subgraph once). attr normalized=False applies the reference's pixel +1
+    convention to IoU; adaptive nms_eta != 1 is not supported (raise).
+    """
+    import jax
+    jnp = _jnp()
+    bboxes, scores = ins["BBoxes"][0], ins["Scores"][0]
+    score_thresh = float(ctx.attr("score_threshold", 0.0))
+    nms_thresh = float(ctx.attr("nms_threshold", 0.3))
+    nms_top_k = int(ctx.attr("nms_top_k", 400))
+    keep_top_k = int(ctx.attr("keep_top_k", 100))
+    bg = int(ctx.attr("background_label", 0))
+    norm = 0.0 if ctx.attr("normalized", True) else 1.0
+    if float(ctx.attr("nms_eta", 1.0)) != 1.0:
+        raise NotImplementedError(
+            "multiclass_nms: adaptive nms_eta is not supported on the "
+            "fixed-shape TPU sweep; use nms_eta=1.0")
+    N, C, M = scores.shape
+    K = min(nms_top_k, M)
+
+    def per_class(img_boxes, class_scores):
+        sc = jnp.where(class_scores > score_thresh, class_scores, -jnp.inf)
+        top_scores, order = jax.lax.top_k(sc, K)
+        cand = img_boxes[order]
+        iou = _iou_matrix(cand, cand, norm)
+
+        def step(kept, i):
+            over = (iou[i] > nms_thresh) & kept & (jnp.arange(K) < i)
+            keep_i = ~over.any()
+            return kept.at[i].set(keep_i), keep_i
+
+        _, keep = jax.lax.scan(step, jnp.zeros((K,), bool), jnp.arange(K))
+        return jnp.where(keep, top_scores, -jnp.inf), order
+
+    def per_image(img_boxes, img_scores):
+        cls_scores, cls_idx = jax.vmap(
+            lambda srow: per_class(img_boxes, srow))(img_scores)  # [C,K]
+        # mask the background class instead of skipping it (uniform trace)
+        cls_scores = cls_scores.at[bg].set(-jnp.inf)
+        flat_scores = cls_scores.reshape(-1)                       # [C*K]
+        flat_idx = cls_idx.reshape(-1)
+        flat_labels = jnp.repeat(jnp.arange(C, dtype=jnp.int32), K)
+        Kk = min(keep_top_k, flat_scores.shape[0])
+        best, sel = jax.lax.top_k(flat_scores, Kk)
+        valid = best > -jnp.inf
+        lab = jnp.where(valid, flat_labels[sel], -1).astype(jnp.float32)
+        bx = img_boxes[flat_idx[sel]]
+        row = jnp.concatenate([lab[:, None],
+                               jnp.where(valid, best, 0.0)[:, None],
+                               jnp.where(valid[:, None], bx, 0.0)], axis=1)
+        if Kk < keep_top_k:
+            pad = jnp.zeros((keep_top_k - Kk, 6), row.dtype).at[:, 0].set(-1)
+            row = jnp.concatenate([row, pad], 0)
+        return row, jnp.sum(valid.astype(jnp.int32))
+
+    out, num = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out], "NmsRoisNum": [num.astype("int64")]}
+
+
+@register("roi_align", nondiff_inputs=("ROIs", "RoisNum"))
+def roi_align(ctx, ins):
+    """RoIAlign (detection/roi_align_op.cc): bilinear-sampled average per
+    bin. ROIs [R, 4] xyxy in input coords + RoisBatch [R] image index
+    (replaces the reference's LoD row partition). Fully static: R * bins *
+    samples gathers. Differentiable wrt X.
+    """
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]                       # [N, C, H, W]
+    rois = ins["ROIs"][0]                 # [R, 4]
+    batch_idx = (ins.get("RoisNum", [None])[0])
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    spatial_scale = float(ctx.attr("spatial_scale", 1.0))
+    ratio = int(ctx.attr("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_index(jnp, batch_idx, R)
+
+    r = rois * spatial_scale
+    x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+    rw = jnp.maximum(x2 - x1, 1.0)
+    rh = jnp.maximum(y2 - y1, 1.0)
+    bw = rw / pw
+    bh = rh / ph
+
+    # sample grid: [R, ph*ratio] y coords, [R, pw*ratio] x coords
+    sy = (y1[:, None] +
+          (jnp.arange(ph * ratio) + 0.5)[None, :] * (bh / ratio)[:, None])
+    sx = (x1[:, None] +
+          (jnp.arange(pw * ratio) + 0.5)[None, :] * (bw / ratio)[:, None])
+
+    def bilinear(img, ys, xs):
+        # img [C, H, W]; ys [Sy], xs [Sx] -> [C, Sy, Sx]. Reference border
+        # semantics (roi_align_op.h): samples outside [-1, H] x [-1, W]
+        # contribute zero; in-range coords clamp at 0 before interpolating.
+        vy = ((ys >= -1.0) & (ys <= H)).astype(img.dtype)
+        vx = ((xs >= -1.0) & (xs <= W)).astype(img.dtype)
+        ys = jnp.maximum(ys, 0.0)
+        xs = jnp.maximum(xs, 0.0)
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(ys - y0, 0, 1)
+        wx = jnp.clip(xs - x0, 0, 1)
+
+        def at(yy, xx):
+            return img[:, yy.astype("int32")][:, :, xx.astype("int32")]
+
+        val = (at(y0, x0) * ((1 - wy)[:, None] * (1 - wx)[None, :]) +
+               at(y0, x1_) * ((1 - wy)[:, None] * wx[None, :]) +
+               at(y1_, x0) * (wy[:, None] * (1 - wx)[None, :]) +
+               at(y1_, x1_) * (wy[:, None] * wx[None, :]))
+        return val * (vy[:, None] * vx[None, :])
+
+    def per_roi(b, ys, xs):
+        samp = bilinear(x[b], ys, xs)             # [C, ph*ratio, pw*ratio]
+        samp = samp.reshape(C, ph, ratio, pw, ratio)
+        return samp.mean(axis=(2, 4))             # [C, ph, pw]
+
+    out = jax.vmap(per_roi)(bidx, sy, sx)
+    return {"Out": [out]}
+
+
+@register("roi_pool", nondiff_inputs=("ROIs", "RoisNum"))
+def roi_pool(ctx, ins):
+    """RoIPool (roi_pool_op.cc): max per bin. TPU-native: max over a dense
+    fixed sample grid per bin (8x8 samples covers every pixel for bins up to
+    8px; exact for the common detection scales, documented approximation
+    beyond)."""
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    batch_idx = ins.get("RoisNum", [None])[0]
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    spatial_scale = float(ctx.attr("spatial_scale", 1.0))
+    S = 8   # dense samples per bin side
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_index(jnp, batch_idx, R)
+    r = jnp.round(rois * spatial_scale)
+    x1, y1 = r[:, 0], r[:, 1]
+    rw = jnp.maximum(r[:, 2] - x1 + 1, 1.0)
+    rh = jnp.maximum(r[:, 3] - y1 + 1, 1.0)
+
+    sy = y1[:, None] + (jnp.arange(ph * S) + 0.5)[None, :] * (rh / (ph * S))[:, None]
+    sx = x1[:, None] + (jnp.arange(pw * S) + 0.5)[None, :] * (rw / (pw * S))[:, None]
+
+    def per_roi(b, ys, xs):
+        yy = jnp.clip(jnp.floor(ys), 0, H - 1).astype("int32")
+        xx = jnp.clip(jnp.floor(xs), 0, W - 1).astype("int32")
+        g = x[b][:, yy][:, :, xx]                  # [C, ph*S, pw*S]
+        g = g.reshape(C, ph, S, pw, S)
+        return g.max(axis=(2, 4))
+
+    out = jax.vmap(per_roi)(bidx, sy, sx)
+    return {"Out": [out]}
+
+
+@register("anchor_generator", grad=None)
+def anchor_generator(ctx, ins):
+    """FasterRCNN-style anchors per feature-map cell (anchor_generator_op.cc)."""
+    jnp = _jnp()
+    x = ins["Input"][0]                   # [N, C, H, W]
+    sizes = [float(s) for s in ctx.attr("anchor_sizes", [64.0])]
+    ratios = [float(r) for r in ctx.attr("aspect_ratios", [1.0])]
+    stride = [float(s) for s in ctx.attr("stride", [16.0, 16.0])]
+    offset = float(ctx.attr("offset", 0.5))
+    var = [float(v) for v in ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    H, W = x.shape[2], x.shape[3]
+    base = []
+    # reference convention (anchor_generator_op.h): ratio = h/w, so
+    # w = size/sqrt(ratio), h = size*sqrt(ratio)
+    for s in sizes:
+        for rt in ratios:
+            w = s / np.sqrt(rt)
+            h = s * np.sqrt(rt)
+            base.append([-w / 2, -h / 2, w / 2, h / 2])
+    base = jnp.asarray(np.asarray(base, "float32"))       # [A, 4]
+    cx = (jnp.arange(W) + offset) * stride[0]
+    cy = (jnp.arange(H) + offset) * stride[1]
+    gx, gy = jnp.meshgrid(cx, cy)                          # [H, W]
+    ctr = jnp.stack([gx, gy, gx, gy], axis=-1)             # [H, W, 4]
+    anchors = ctr[:, :, None, :] + base[None, None]        # [H, W, A, 4]
+    variances = jnp.broadcast_to(jnp.asarray(var, "float32"),
+                                 anchors.shape)
+    return {"Anchors": [anchors], "Variances": [variances]}
+
+
+@register("box_clip", grad=None)
+def box_clip(ctx, ins):
+    """box_clip_op.h: clip to round(h/scale)-1 x round(w/scale)-1, per image
+    when boxes carry a leading batch dim matching ImInfo's rows."""
+    jnp = _jnp()
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]    # [..,4], [N,3] h,w,s
+    scale = im_info[:, 2]
+    hmax = jnp.round(im_info[:, 0] / scale) - 1.0         # [N]
+    wmax = jnp.round(im_info[:, 1] / scale) - 1.0
+    if boxes.ndim >= 3 and boxes.shape[0] == im_info.shape[0]:
+        bshape = (boxes.shape[0],) + (1,) * (boxes.ndim - 2)
+        h = hmax.reshape(bshape)
+        w = wmax.reshape(bshape)
+    else:
+        h, w = hmax[0], wmax[0]
+    x1 = jnp.clip(boxes[..., 0], 0, w)
+    y1 = jnp.clip(boxes[..., 1], 0, h)
+    x2 = jnp.clip(boxes[..., 2], 0, w)
+    y2 = jnp.clip(boxes[..., 3], 0, h)
+    return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+
+
+@register("bipartite_match", grad=None, nondiff_inputs=("DistMat",))
+def bipartite_match(ctx, ins):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take the
+    globally-largest entry, retire its row+column. Fixed G iterations of a
+    lax scan (G = #ground-truth rows)."""
+    import jax
+    jnp = _jnp()
+    dist = ins["DistMat"][0]                               # [G, M]
+    G, M = dist.shape
+    match_type = ctx.attr("match_type", "bipartite")
+
+    def step(carry, _):
+        d, row_ids, match = carry
+        flat = jnp.argmax(d)
+        g, m = flat // M, flat % M
+        ok = d[g, m] > 0
+        match = jnp.where(ok, match.at[m].set(g.astype(jnp.int32)), match)
+        row_ids = jnp.where(ok, row_ids.at[m].set(d[g, m]), row_ids)
+        d = jnp.where(ok, d.at[g, :].set(-1.0).at[:, m].set(-1.0), d)
+        return (d, row_ids, match), None
+
+    match0 = jnp.full((M,), -1, jnp.int32)
+    dist0 = jnp.where(dist > 0, dist, 0.0)
+    (d, scores, match), _ = jax.lax.scan(
+        step, (dist0, jnp.zeros((M,), dist.dtype), match0), None, length=G)
+    if match_type == "per_prediction":
+        thr = float(ctx.attr("dist_threshold", 0.5))
+        best_g = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_v = jnp.max(dist, axis=0)
+        extra = (match < 0) & (best_v >= thr)
+        match = jnp.where(extra, best_g, match)
+        scores = jnp.where(extra, best_v, scores)
+    return {"ColToRowMatchIndices": [match[None, :]],
+            "ColToRowMatchDist": [scores[None, :]]}
+
+
+@register("target_assign", grad=None,
+          nondiff_inputs=("X", "MatchIndices", "NegIndices"))
+def target_assign(ctx, ins):
+    """Scatter ground-truth rows to matched predictions (target_assign_op.cc).
+    X [G, K]; MatchIndices [1, M] (-1 = unmatched). Out [M, K] + OutWeight."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0].reshape(-1).astype("int32")
+    mismatch_value = float(ctx.attr("mismatch_value", 0.0))
+    safe = jnp.maximum(match, 0)
+    out = x[safe]
+    matched = (match >= 0)[:, None]
+    out = jnp.where(matched, out, mismatch_value)
+    w = matched.astype(x.dtype)
+    return {"Out": [out], "OutWeight": [w]}

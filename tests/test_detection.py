@@ -1,0 +1,273 @@
+"""Detection suite tests (reference test_multiclass_nms_op.py,
+test_roi_align_op.py, test_bipartite_match_op.py, test_target_assign_op.py,
+test_anchor_generator_op.py): numpy brute-force oracles against the
+fixed-shape TPU lowerings."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feed, n_fetch=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+def _np_iou(a, b):
+    area = lambda z: np.maximum(z[:, 2] - z[:, 0], 0) * \
+        np.maximum(z[:, 3] - z[:, 1], 0)
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area(a)[:, None] + area(b)[None, :] - inter + 1e-10)
+
+
+def _np_nms(boxes, scores, thresh, score_thresh):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        if scores[i] <= score_thresh:
+            continue
+        if all(_np_iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] <= thresh
+               for j in keep):
+            keep.append(i)
+    return keep
+
+
+def test_multiclass_nms_matches_numpy():
+    rng = np.random.RandomState(0)
+    N, M, C = 2, 24, 4
+    ctr = rng.rand(N, M, 2) * 80
+    wh = rng.rand(N, M, 2) * 30 + 4
+    boxes = np.concatenate([ctr - wh / 2, ctr + wh / 2], -1).astype("float32")
+    scores = rng.rand(N, C, M).astype("float32")
+
+    def build():
+        bv = fluid.data("boxes", [M, 4], "float32")
+        sv = fluid.data("scores", [C, M], "float32")
+        out, num = layers.multiclass_nms(bv, sv, score_threshold=0.3,
+                                         nms_top_k=20, keep_top_k=10,
+                                         nms_threshold=0.4,
+                                         background_label=0)
+        return [out, num]
+    out, num = _run(build, {"boxes": boxes, "scores": scores}, 2)
+
+    for n in range(N):
+        expect = []
+        for c in range(1, C):
+            for j in _np_nms(boxes[n], scores[n, c], 0.4, 0.3):
+                expect.append((scores[n, c, j], c, j))
+        expect.sort(reverse=True)
+        expect = expect[:10]
+        assert int(num[n]) == len(expect)
+        got = out[n]
+        for k, (s, c, j) in enumerate(expect):
+            assert int(got[k, 0]) == c
+            np.testing.assert_allclose(got[k, 1], s, rtol=1e-5)
+            np.testing.assert_allclose(got[k, 2:], boxes[n, j], rtol=1e-5)
+        assert (got[len(expect):, 0] == -1).all()
+
+
+def test_roi_align_matches_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 16, 16).astype("float32")
+    rois = np.array([[2.0, 2.0, 10.0, 10.0], [4.0, 0.0, 12.0, 8.0],
+                     [0.0, 0.0, 15.0, 15.0]], "float32")
+    counts = np.array([2, 1], "int64")          # rois 0,1 -> img 0; roi 2 -> img 1
+
+    def build():
+        xv = fluid.data("x", [3, 16, 16], "float32")
+        rv = fluid.data("rois", [4], "float32")
+        nv = fluid.data("cnt", [], "int64")
+        out = layers.roi_align(xv, rv, pooled_height=2, pooled_width=2,
+                               spatial_scale=1.0, sampling_ratio=2,
+                               rois_num=nv)
+        return [out]
+    out, = _run(build, {"x": x, "rois": rois, "cnt": counts})
+    assert out.shape == (3, 3, 2, 2)
+
+    def np_roi_align(img, roi, ph, pw, ratio):
+        x1, y1, x2, y2 = roi
+        rw, rh = max(x2 - x1, 1.0), max(y2 - y1, 1.0)
+        bw, bh = rw / pw, rh / ph
+        C, H, W = img.shape
+        res = np.zeros((C, ph, pw), "float32")
+        for i in range(ph):
+            for j in range(pw):
+                vals = []
+                for iy in range(ratio):
+                    for ix in range(ratio):
+                        sy = y1 + (i * ratio + iy + 0.5) * bh / ratio
+                        sx = x1 + (j * ratio + ix + 0.5) * bw / ratio
+                        y0 = int(np.clip(np.floor(sy), 0, H - 1))
+                        x0 = int(np.clip(np.floor(sx), 0, W - 1))
+                        y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                        wy = np.clip(sy - y0, 0, 1)
+                        wx = np.clip(sx - x0, 0, 1)
+                        vals.append(
+                            img[:, y0, x0] * (1 - wy) * (1 - wx) +
+                            img[:, y0, x1_] * (1 - wy) * wx +
+                            img[:, y1_, x0] * wy * (1 - wx) +
+                            img[:, y1_, x1_] * wy * wx)
+                res[:, i, j] = np.mean(vals, 0)
+        return res
+
+    np.testing.assert_allclose(out[0], np_roi_align(x[0], rois[0], 2, 2, 2),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out[2], np_roi_align(x[1], rois[2], 2, 2, 2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_gradients_flow():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 8, 8).astype("float32")
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], "float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.data("x", [2, 8, 8], "float32")
+        xv.stop_gradient = False
+        rv = fluid.data("rois", [4], "float32")
+        out = layers.roi_align(xv, rv, 2, 2)
+        loss = fluid.layers.reduce_sum(out)
+        g = fluid.gradients(loss, [xv])[0]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        gv, = exe.run(main, feed={"x": x, "rois": rois}, fetch_list=[g])
+    assert np.asarray(gv).shape == x.shape
+    assert np.abs(np.asarray(gv)).sum() > 0
+
+
+def test_bipartite_match_and_target_assign():
+    dist = np.array([[0.8, 0.2, 0.6, 0.0],
+                     [0.1, 0.9, 0.3, 0.0]], "float32")
+
+    def build():
+        dv = fluid.data("d", [4], "float32")
+        dv2 = fluid.default_main_program().current_block().create_var(
+            "dmat", (2, 4), "float32")
+        fluid.layers.assign(dv, dv2)
+        idx, md = layers.bipartite_match(dv2)
+        gt = fluid.layers.fill_constant([2, 3], "float32", 0.0)
+        gt2 = fluid.layers.elementwise_add(
+            gt, fluid.layers.reshape(
+                fluid.layers.cast(fluid.layers.fill_constant(
+                    [2, 1], "float32", 5.0), "float32"), [2, 1]))
+        t, w = layers.target_assign(gt2, idx, mismatch_value=-1.0)
+        return [idx, md, t, w]
+    idx, md, t, w = _run(build, {"d": dist}, 4)
+    # greedy: (1,1)=0.9 first, then (0,0)=0.8; col 2 unmatched (0.6 row taken)
+    np.testing.assert_array_equal(idx[0], [0, 1, -1, -1])
+    np.testing.assert_allclose(md[0], [0.8, 0.9, 0.0, 0.0], rtol=1e-6)
+    assert t.shape == (4, 3)
+    np.testing.assert_allclose(t[0], 5.0)
+    np.testing.assert_allclose(t[2], -1.0)
+    np.testing.assert_allclose(w[:, 0], [1, 1, 0, 0])
+
+
+def test_anchor_generator_and_box_clip():
+    def build():
+        xv = fluid.data("x", [8, 4, 4], "float32")
+        anchors, variances = layers.anchor_generator(
+            xv, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        im = fluid.layers.assign(np.array([[50.0, 50.0, 1.0]], "float32"))
+        clipped = layers.box_clip(anchors, im)
+        return [anchors, variances, clipped]
+    anchors, variances, clipped = _run(
+        build, {"x": np.zeros((1, 8, 4, 4), "float32")}, 3)
+    assert anchors.shape == (4, 4, 2, 4)
+    # cell (0,0) anchor 0: centered at (8, 8) with size 32
+    np.testing.assert_allclose(anchors[0, 0, 0], [-8, -8, 24, 24], rtol=1e-5)
+    assert variances.shape == anchors.shape
+    assert clipped.min() >= 0 and clipped.max() <= 49.0
+
+
+def test_ssd_loss_trains():
+    rng = np.random.RandomState(3)
+    M, G, C = 16, 3, 5
+    prior = np.sort(rng.rand(M, 2) * 60, axis=0)
+    prior = np.concatenate([prior, prior + 8 + rng.rand(M, 2) * 10],
+                           1).astype("float32")
+    gt_box = prior[[2, 7, 12]] + rng.randn(3, 4).astype("float32")
+    gt_label = rng.randint(1, C, (G, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 4
+    startup.random_seed = 4
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)   # per-image static shapes
+        feat = fluid.data("feat", [M, 8], "float32", **A)
+        gb = fluid.data("gt_box", [G, 4], "float32", **A)
+        gl = fluid.data("gt_label", [G, 1], "int64", **A)
+        pb = fluid.layers.assign(prior)
+        loc = fluid.layers.fc(feat, 4)
+        conf = fluid.layers.fc(feat, C)
+        loss = layers.ssd_loss(loc, conf, gb, gl, pb)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    feed = {"feat": rng.randn(M, 8).astype("float32"),
+            "gt_box": gt_box, "gt_label": gt_label}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_anchor_ratio_convention_and_border_sampling():
+    """ratio = h/w (reference anchor_generator_op.h); roi_align samples
+    outside [-1, H]x[-1, W] contribute zero (not border replication)."""
+    def build():
+        xv = fluid.data("x", [2, 2, 2], "float32")
+        anchors, _ = layers.anchor_generator(
+            xv, anchor_sizes=[32.0], aspect_ratios=[4.0],
+            stride=[16.0, 16.0])
+        xi = fluid.data("img", [1, 4, 4], "float32")
+        rois = fluid.data("rois", [4], "float32")
+        pooled = layers.roi_align(xi, rois, 1, 1, sampling_ratio=1)
+        return [anchors, pooled]
+    img = np.ones((1, 1, 4, 4), "float32")
+    rois = np.array([[-6.0, -6.0, 2.0, 2.0]], "float32")  # half off-image
+    anchors, pooled = _run(build, {
+        "x": np.zeros((1, 2, 2, 2), "float32"), "img": img, "rois": rois}, 2)
+    a = anchors[0, 0, 0]
+    w, h = a[2] - a[0], a[3] - a[1]
+    assert h > w, f"ratio=4 must be TALL (h/w=4): got w={w}, h={h}"
+    np.testing.assert_allclose(h / w, 4.0, rtol=1e-5)
+    # the single sample point lands at (-2, -2): outside [-1, 4] -> zero
+    np.testing.assert_allclose(pooled[0, 0], 0.0, atol=1e-6)
+
+
+def test_multiclass_nms_pixel_convention():
+    """normalized=False applies the +1 pixel convention to IoU: two boxes
+    that overlap just under the threshold in normalized coords cross it in
+    pixel coords (smaller effective areas -> larger IoU)."""
+    boxes = np.array([[[0, 0, 9, 9], [0, 0, 9, 4]]], "float32")
+    scores = np.zeros((1, 2, 2), "float32")
+    scores[0, 1] = [0.9, 0.8]
+
+    boxes[0] = [[0, 0, 8, 8], [0, 0, 8, 3]]
+    # normalized: inter 8*3=24, union 64+24-24=64 -> 0.375 < 0.45 (2 kept)
+    # pixel(+1): inter 9*4=36, union 81+36-36=81 -> 0.444 < 0.45 (2 kept)
+    # threshold 0.4 separates them: 0.375 < 0.4 <= 0.444
+    def run_t(norm):
+        def build():
+            bv = fluid.data("b", [2, 4], "float32")
+            sv = fluid.data("s", [2, 2], "float32")
+            out, num = layers.multiclass_nms(
+                bv, sv, score_threshold=0.1, nms_top_k=2, keep_top_k=2,
+                nms_threshold=0.4, normalized=norm)
+            return [num]
+        num, = _run(build, {"b": boxes, "s": scores})
+        return int(num[0])
+    assert run_t(True) == 2    # 0.375 below threshold: both kept
+    assert run_t(False) == 1   # 0.444 above: suppressed
