@@ -1,0 +1,148 @@
+"""Post-training quantization (reference: python/paddle/fluid/contrib/slim/
+quantization/quantization_pass.py + contrib/quantize/quantize_transpiler.py).
+
+TPU-native design: the reference inserts fake_quantize/fake_dequantize op
+pairs to simulate int8 on fp32 hardware. On TPU the useful serving form is
+WEIGHT-ONLY int8: weights are stored int8 with per-output-channel symmetric
+scales (4x less HBM and checkpoint size -- the TPU bottleneck), and the
+lowering dequantizes to bf16 right at the consuming matmul, where XLA fuses
+the multiply into the MXU feed. Accuracy loss is the int8 rounding only
+(~1e-2 relative), no activation quantization error. Full int8xint8 MXU
+compute (activations quantized dynamically) is the documented next step
+(SCOPE.md open gap #4).
+
+API::
+
+    quantize_weights(program, scope)           # rewrite in place, returns
+                                               # {param: (bits, scale_name)}
+    # then run / save_inference_model as usual -- the checkpoint stores int8
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.registry import register
+from ..framework import Program
+
+# ops whose weight input can be quantized: slot holding the weight
+_WEIGHT_SLOTS = {"mul": "Y", "matmul": "Y", "conv2d": "Filter",
+                 "conv3d": "Filter", "conv2d_transpose": "Filter"}
+
+
+@register("dequantize_weight", grad=None,
+          nondiff_inputs=("X", "Scale"))
+def dequantize_weight(ctx, ins):
+    """int8 weight + per-channel scale -> compute dtype. XLA fuses this into
+    the consuming matmul/conv (one multiply on the MXU feed path)."""
+    import jax.numpy as jnp
+    w8, scale = ins["X"][0], ins["Scale"][0]
+    axis = int(ctx.attr("channel_axis", -1))
+    dtype = ctx.attr("out_dtype", "float32")
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.dtype(dtype)
+    shape = [1] * w8.ndim
+    shape[axis] = w8.shape[axis]
+    return {"Out": [(w8.astype(jnp.float32) *
+                     scale.reshape(shape)).astype(dt)]}
+
+
+def _quantize_array(w: np.ndarray, channel_axis: int, bits: int):
+    qmax = 2 ** (bits - 1) - 1
+    red = tuple(i for i in range(w.ndim) if i != channel_axis)
+    scale = np.max(np.abs(w), axis=red).astype("float32") / qmax
+    scale = np.maximum(scale, 1e-12)
+    shape = [1] * w.ndim
+    shape[channel_axis] = w.shape[channel_axis]
+    q = np.clip(np.round(w / scale.reshape(shape)), -qmax - 1, qmax)
+    return q.astype("int8"), scale
+
+
+def quantize_weights(program: Program, scope, weight_bits: int = 8,
+                     quantizable_op_type: Optional[Sequence[str]] = None,
+                     min_elements: int = 1024) -> Dict[str, Tuple[int, str]]:
+    """Weight-only PTQ rewrite (the quant_transpiler analog).
+
+    For each weight input of a quantizable op: store the int8 array +
+    per-output-channel scale in the scope, and insert a dequantize_weight op
+    ahead of the consumer. Params smaller than ``min_elements`` are skipped
+    (no memory win, pure accuracy cost). Returns {param_name: (bits,
+    scale_var_name)}. Run on an inference program (clone(for_test=True) or a
+    loaded inference model); training through quantized weights is QAT,
+    which this pass does not do.
+    """
+    ops = set(quantizable_op_type or _WEIGHT_SLOTS)
+    block = program.global_block()
+    done: Dict[str, Tuple[int, str]] = {}
+    insertions = []   # (op_index, weight_name, deq_name)
+
+    for idx, op in enumerate(block.ops):
+        slot = _WEIGHT_SLOTS.get(op.type)
+        if op.type not in ops or slot is None:
+            continue
+        for i, name in enumerate(op.inputs.get(slot, [])):
+            v = block.find_var_recursive(name)
+            w = scope.find_var(name)
+            if v is None or w is None or not getattr(v, "persistable", False):
+                continue
+            w = np.asarray(w)
+            if w.size < min_elements or w.dtype.kind != "f":
+                continue
+            # output channels: matmul weights last dim; conv filters dim 0;
+            # transpose-conv filters [C_in, C_out, ...] -> dim 1
+            if "transpose" in op.type:
+                ch = 1
+            elif "conv" in op.type:
+                ch = 0
+            else:
+                ch = w.ndim - 1
+            deq_name = name + "@deq"
+            if name not in done:
+                q, scale = _quantize_array(w, ch, weight_bits)
+                scope.set_var(name, q)
+                scope.set_var(name + "@scale", scale)
+                v.dtype = "int8"
+                sv = block.create_var(name + "@scale", tuple(scale.shape),
+                                      "float32")
+                sv.persistable = True
+                dv = block.create_var(deq_name, tuple(w.shape),
+                                      str(w.dtype) if w.dtype != np.dtype(
+                                          "V2") else "bfloat16")
+                dv.stop_gradient = True
+                done[name] = (weight_bits, name + "@scale")
+                insertions.append((idx, name, ch, str(dv.dtype)))
+            op.inputs[slot][i] = deq_name
+
+    # insert dequantize ops (reverse order keeps indices valid)
+    for idx, name, ch, dtype in sorted(insertions, reverse=True):
+        block.insert_op(
+            idx, "dequantize_weight",
+            inputs={"X": [name], "Scale": [name + "@scale"]},
+            outputs={"Out": [name + "@deq"]},
+            attrs={"channel_axis": ch, "out_dtype": dtype},
+            infer_shape=False)
+    program._bump()
+    return done
+
+
+class QuantizeTranspiler:
+    """Facade matching the reference's contrib.quantize.QuantizeTranspiler."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        if activation_quantize_type not in (None, "abs_max"):
+            raise NotImplementedError(
+                "activation quantization: TPU PTQ here is weight-only "
+                "(SCOPE.md open gap #4); activations stay bf16")
+        self.weight_bits = weight_bits
+
+    def training_transpile(self, program=None, startup_program=None):
+        raise NotImplementedError(
+            "QAT fake-quant training is not built (SCOPE.md); use bf16 AMP "
+            "for training and quantize_weights() for serving")
+
+    def freeze_program(self, program, place=None, scope=None):
+        from ..core.executor import global_scope
+        return quantize_weights(program, scope or global_scope(),
+                                self.weight_bits)

@@ -29,12 +29,15 @@ def box_coder(prior_box, prior_box_var, target_box,
               axis=0):
     helper = LayerHelper("box_coder", name=name)
     out = _out(helper, target_box.dtype)
-    helper.append_op("box_coder",
-                     inputs={"PriorBox": [prior_box],
-                             "TargetBox": [target_box]},
-                     outputs={"OutputBox": [out]},
-                     attrs={"code_type": code_type,
-                            "box_normalized": box_normalized})
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized}
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            attrs["variance"] = [float(v) for v in prior_box_var]
+        else:
+            inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs)
     return helper.main_program.current_block().var(out.name)
 
 
@@ -221,6 +224,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     total = _nn.elementwise_add(_nn.scale(loc_l, float(loc_loss_weight)),
                                 _nn.scale(conf_l, float(conf_loss_weight)))
     if normalize:
-        denom = _nn.scale(_nn.reduce_sum(loc_w), 0.25, bias=1e-6)
+        # loc_w is [M,1]: sum == #matched priors (the reference's normalizer)
+        denom = _nn.scale(_nn.reduce_sum(loc_w), 1.0, bias=1e-6)
         total = _nn.elementwise_div(total, denom)
     return total

@@ -35,9 +35,17 @@ def iou_similarity(ctx, ins):
 
 @register("box_coder", grad=None)
 def box_coder(ctx, ins):
+    """box_coder_op.cc: encode divides the center-size offsets by the prior
+    variances; decode multiplies them back (PriorBoxVar [M,4] input or the
+    4-float `variance` attr; absent -> ones)."""
     jnp = _jnp()
     prior = ins["PriorBox"][0]  # [M,4]
     target = ins["TargetBox"][0]
+    pv = ins.get("PriorBoxVar", [None])[0]
+    if pv is None:
+        var_attr = ctx.attr("variance", None)
+        pv = (jnp.asarray(np.asarray(var_attr, "float32"))[None, :]
+              if var_attr else None)
     code_type = ctx.attr("code_type", "encode_center_size")
     pw = prior[:, 2] - prior[:, 0]
     ph = prior[:, 3] - prior[:, 1]
@@ -50,8 +58,12 @@ def box_coder(ctx, ins):
         tcy = target[:, 1] + 0.5 * th
         out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
                          jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        if pv is not None:
+            out = out / pv
     else:
         t = target.reshape(-1, prior.shape[0], 4)
+        if pv is not None:
+            t = t * pv[None] if pv.ndim == 2 else t * pv
         ocx = pcx + t[..., 0] * pw
         ocy = pcy + t[..., 1] * ph
         ow = jnp.exp(t[..., 2]) * pw
@@ -154,36 +166,6 @@ def _roi_batch_index(jnp, rois_num, R):
                             side="right").astype("int32")
 
 
-def _nms_keep(boxes, scores, iou_threshold, max_out):
-    """Fixed-size greedy NMS on score-sorted candidates.
-
-    Returns (idx [max_out] int32 into `boxes`, valid [max_out] bool).
-    The reference's multiclass_nms emits a ragged LoD tensor
-    (detection/multiclass_nms_op.cc); XLA needs static shapes, so the output
-    is padded + a validity mask -- the standard TPU NMS formulation: sort by
-    score, then a lax.scan sweep keeps a box iff it does not overlap an
-    already-kept higher-scoring box.
-    """
-    import jax
-    jnp = _jnp()
-    K = min(int(max_out), boxes.shape[0])
-    top_scores, order = jax.lax.top_k(scores, K)
-    cand = boxes[order]                                  # [K, 4]
-    iou = _iou_matrix(cand, cand)                        # [K, K]
-
-    def step(kept, i):
-        # kept: [K] bool of already-kept candidates (all lower index = higher
-        # score). candidate i survives iff no kept j<i overlaps it.
-        over = (iou[i] > iou_threshold) & kept & \
-            (jnp.arange(K) < i)
-        keep_i = ~over.any()
-        return kept.at[i].set(keep_i), keep_i
-
-    kept0 = jnp.zeros((K,), bool)
-    _, keep = jax.lax.scan(step, kept0, jnp.arange(K))
-    return order, keep & (top_scores > -jnp.inf)
-
-
 @register("multiclass_nms", grad=None, nondiff_inputs=("BBoxes", "Scores"))
 def multiclass_nms(ctx, ins):
     """Per-class NMS + cross-class top-k (multiclass_nms_op.cc).
@@ -228,8 +210,10 @@ def multiclass_nms(ctx, ins):
     def per_image(img_boxes, img_scores):
         cls_scores, cls_idx = jax.vmap(
             lambda srow: per_class(img_boxes, srow))(img_scores)  # [C,K]
-        # mask the background class instead of skipping it (uniform trace)
-        cls_scores = cls_scores.at[bg].set(-jnp.inf)
+        # mask the background class instead of skipping it (uniform trace);
+        # bg=-1 is the reference's "no background class" sentinel
+        if bg >= 0:
+            cls_scores = cls_scores.at[bg].set(-jnp.inf)
         flat_scores = cls_scores.reshape(-1)                       # [C*K]
         flat_idx = cls_idx.reshape(-1)
         flat_labels = jnp.repeat(jnp.arange(C, dtype=jnp.int32), K)
@@ -267,6 +251,9 @@ def roi_align(ctx, ins):
     spatial_scale = float(ctx.attr("spatial_scale", 1.0))
     ratio = int(ctx.attr("sampling_ratio", -1))
     if ratio <= 0:
+        # the reference adapts samples-per-bin to ceil(roi/pooled) PER ROI --
+        # a data-dependent shape XLA cannot compile. Fixed grid instead;
+        # raise sampling_ratio for large-ROI fidelity (documented deviation)
         ratio = 2
     N, C, H, W = x.shape
     R = rois.shape[0]

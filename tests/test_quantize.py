@@ -1,0 +1,53 @@
+import numpy as np
+import pytest
+import paddle_tpu as fluid
+from paddle_tpu.contrib import quantize as Q
+
+
+def test_weight_only_ptq_close_and_small(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 6
+    startup.random_seed = 6
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [64], "float32")
+        h = fluid.layers.fc(x, 128, act="relu")
+        img = fluid.layers.reshape(h, [-1, 2, 8, 8])
+        c = fluid.layers.conv2d(img, 8, 3, padding=1, act="relu")
+        logits = fluid.layers.fc(c, 10)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 64).astype("float32")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[logits])
+        qmap = Q.quantize_weights(main, scope)
+        # fc weights + conv filter quantized; biases skipped (tiny)
+        assert any(".w_0" in k or "w_0" in k for k in qmap)
+        for name in qmap:
+            assert scope.find_var(name).dtype == np.int8
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[logits])
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.02 * scale, (
+        np.abs(got - ref).max(), scale)
+
+    # int8 survives the checkpoint: save + Predictor serve
+    d = str(tmp_path / "qmodel")
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(d, ["x"], [logits], exe, main)
+    pred = fluid.inference.Predictor(d)
+    out, = pred.run({"x": xv})
+    np.testing.assert_allclose(out, got, rtol=1e-4, atol=1e-4)
+    import os, glob
+    w8 = [f for f in glob.glob(d + "/*.npy")
+          if np.load(f, allow_pickle=False).dtype == np.int8]
+    assert w8, "no int8 weight files in the saved model"
+
+
+def test_quantize_transpiler_facade():
+    t = fluid.contrib.quantize.QuantizeTranspiler(weight_bits=8)
+    with pytest.raises(NotImplementedError):
+        t.training_transpile()
+    with pytest.raises(NotImplementedError):
+        fluid.contrib.quantize.QuantizeTranspiler(
+            activation_quantize_type="moving_average_abs_max")
