@@ -1,2 +1,3 @@
 from . import flops  # noqa: F401
 from .flops import program_flops, device_peak_flops  # noqa: F401
+from .checkpointer import Checkpointer  # noqa: F401

@@ -51,3 +51,61 @@ def test_quantize_transpiler_facade():
     with pytest.raises(NotImplementedError):
         fluid.contrib.quantize.QuantizeTranspiler(
             activation_quantize_type="moving_average_abs_max")
+
+
+def test_int8_compute_mode():
+    """int8_compute=True: mul ops run the real int8xint8->int32 MXU kernel
+    with dynamic activation scales; outputs stay close to fp32."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 8
+    startup.random_seed = 8
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [64], "float32")
+        h = fluid.layers.fc(x, 128, act="relu")
+        logits = fluid.layers.fc(h, 10)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(32, 64).astype("float32")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[logits])
+        Q.quantize_weights(main, scope, int8_compute=True)
+        types = [op.type for op in main.global_block().ops]
+        assert "quantized_mul" in types, types
+        assert "dequantize_weight" not in types  # all matmul consumers swapped
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[logits])
+    scale = np.abs(ref).max()
+    # activation+weight rounding: looser than weight-only but still close
+    assert np.abs(got - ref).max() < 0.05 * scale, (
+        np.abs(got - ref).max(), scale)
+
+
+def test_bf16_weights_quantize_and_shared_consumer_safe():
+    """bf16 params quantize (ml_dtypes kind 'V'); a non-matmul consumer of a
+    quantized weight reads the dequantized view, not raw int8 codes."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    startup.random_seed = 9
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [64], "bfloat16")
+        h = fluid.layers.fc(x, 64, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="tied_w"))
+        # second consumer of the SAME weight through a non-weight slot
+        wsum = fluid.layers.reduce_sum(
+            fluid.default_main_program().global_block().var("tied_w"))
+        out = fluid.layers.elementwise_add(
+            fluid.layers.reduce_sum(h), wsum)
+    rng = np.random.RandomState(2)
+    xv = rng.randn(8, 64).astype("float32")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        qmap = Q.quantize_weights(main, scope)
+        assert "tied_w" in qmap, "bf16 weight was silently skipped"
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    # int8 rounding only -- a raw-int8 read would be off by orders of magnitude
+    assert np.abs(got - ref).max() < 0.05 * max(np.abs(ref).max(), 1.0), (
+        got, ref)
