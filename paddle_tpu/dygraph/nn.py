@@ -276,3 +276,211 @@ class Sequential(Layer):
         for n in self._order:
             x = self._sub_layers[n](x)
         return x
+
+
+def _tuple_n(v, n):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
+class _ConvNd(Layer):
+    """Shared machinery for the conv family (reference dygraph/nn.py Conv2D
+    :35, Conv3D, Conv2DTranspose, Conv3DTranspose)."""
+
+    def __init__(self, op_type, ndim, transpose, num_channels, num_filters,
+                 filter_size, stride=1, padding=0, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = _tuple_n(filter_size, ndim)
+        if transpose:
+            wshape = [num_channels, num_filters // (groups or 1)] + fs
+        else:
+            wshape = [num_filters, num_channels // (groups or 1)] + fs
+        self.weight = self.create_parameter(wshape)
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([num_filters], is_bias=True))
+        self._op_type = op_type
+        self._attrs = {"strides": _tuple_n(stride, ndim),
+                       "paddings": _tuple_n(padding, ndim),
+                       "dilations": _tuple_n(dilation, ndim),
+                       "groups": groups or 1}
+        self._act = act
+
+    def forward(self, x):
+        out = trace_op(self._op_type,
+                       {"Input": [x], "Filter": [self.weight]},
+                       self._attrs, ["Output"])["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": 1}, ["Out"])["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, num_channels, num_filters, filter_size, **kw):
+        super().__init__("conv2d_transpose", 2, True, num_channels,
+                         num_filters, filter_size, **kw)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, num_channels, num_filters, filter_size, **kw):
+        super().__init__("conv3d", 3, False, num_channels, num_filters,
+                         filter_size, **kw)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, num_channels, num_filters, filter_size, **kw):
+        super().__init__("conv3d_transpose", 3, True, num_channels,
+                         num_filters, filter_size, **kw)
+
+
+class GroupNorm(Layer):
+    """Reference dygraph/nn.py GroupNorm."""
+
+    def __init__(self, channels, groups=32, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([channels], initializer="ones")
+        self.bias = self.create_parameter([channels], is_bias=True)
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._act = act
+
+    def forward(self, x):
+        out = trace_op("group_norm",
+                       {"X": [x], "Scale": [self.weight],
+                        "Bias": [self.bias]},
+                       self._attrs, ["Y"])["Y"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out
+
+
+class PRelu(Layer):
+    """Reference dygraph/nn.py PRelu."""
+
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [int(channel)]
+        else:
+            shape = [int(np.prod(input_shape[1:]))]
+        self.weight = self.create_parameter(shape, initializer="zeros")
+        self._mode = mode
+
+    def forward(self, x):
+        return trace_op("prelu", {"X": [x], "Alpha": [self.weight]},
+                        {"mode": self._mode}, ["Out"])["Out"][0]
+
+
+class BilinearTensorProduct(Layer):
+    """Reference dygraph/nn.py BilinearTensorProduct."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([1, output_dim],
+                                                is_bias=True))
+        self._act = act
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = trace_op("bilinear_tensor_product", ins, {}, ["Out"])["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out
+
+
+class RowConv(Layer):
+    """Reference dygraph/nn.py RowConv (lookahead convolution)."""
+
+    def __init__(self, input_dim, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [future_context_size + 1, input_dim])
+        self._act = act
+
+    def forward(self, x):
+        out = trace_op("row_conv", {"X": [x], "Filter": [self.weight]},
+                       {}, ["Out"])["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out
+
+
+class GRUUnit(Layer):
+    """Reference dygraph/nn.py GRUUnit: one GRU step over pre-projected
+    gate input [B, 3H] + hidden [B, H]; returns (hidden, reset_hidden, gate).
+    Composed from registry ops on the tape. Gate math matches
+    operators/gru_unit_op.h: u, r see h @ W_ur; the candidate sees
+    (r*h) @ W_c only (NOT h @ W_c); origin_mode flips the update mix."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        H = size // 3
+        self._h = H
+        self._origin = origin_mode
+        self.weight = self.create_parameter([H, 3 * H])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([3 * H], is_bias=True))
+        self._act, self._gate_act = activation, gate_activation
+
+    def forward(self, gate_input, hidden):
+        H = self._h
+
+        def op(t, ins, attrs, outs=("Out",)):
+            return trace_op(t, ins, attrs, list(outs))[outs[0]][0]
+
+        def sl(x, lo, hi, axis=1):
+            return op("slice", {"Input": [x]},
+                      {"axes": [axis], "starts": [lo], "ends": [hi]})
+
+        w_ur = sl(self.weight, 0, 2 * H)
+        w_c = sl(self.weight, 2 * H, 3 * H)
+        ur_in = op("elementwise_add",
+                   {"X": [sl(gate_input, 0, 2 * H)],
+                    "Y": [op("mul", {"X": [hidden], "Y": [w_ur]},
+                             {"x_num_col_dims": 1, "y_num_col_dims": 1})]},
+                   {"axis": -1})
+        if self.bias is not None:
+            ur_in = op("elementwise_add",
+                       {"X": [ur_in], "Y": [sl(self.bias, 0, 2 * H, axis=0)]},
+                       {"axis": 1})
+        u = op(self._gate_act, {"X": [sl(ur_in, 0, H)]}, {})
+        r = op(self._gate_act, {"X": [sl(ur_in, H, 2 * H)]}, {})
+        rh = op("elementwise_mul", {"X": [r], "Y": [hidden]}, {"axis": -1})
+        c_in = op("elementwise_add",
+                  {"X": [sl(gate_input, 2 * H, 3 * H)],
+                   "Y": [op("mul", {"X": [rh], "Y": [w_c]},
+                            {"x_num_col_dims": 1, "y_num_col_dims": 1})]},
+                  {"axis": -1})
+        if self.bias is not None:
+            c_in = op("elementwise_add",
+                      {"X": [c_in],
+                       "Y": [sl(self.bias, 2 * H, 3 * H, axis=0)]},
+                      {"axis": 1})
+        c = op(self._act, {"X": [c_in]}, {})
+        one_minus_u = op("scale", {"X": [u]}, {"scale": -1.0, "bias": 1.0})
+        if self._origin:     # h = (1-u)*h + u*c (original-paper convention)
+            a, b = one_minus_u, u
+        else:                # h = u*h + (1-u)*c (paddle default)
+            a, b = u, one_minus_u
+        nh = op("elementwise_add",
+                {"X": [op("elementwise_mul", {"X": [a], "Y": [hidden]},
+                          {"axis": -1})],
+                 "Y": [op("elementwise_mul", {"X": [b], "Y": [c]},
+                          {"axis": -1})]},
+                {"axis": -1})
+        gate = op("concat", {"X": [ur_in, c_in]}, {"axis": 1})
+        return nh, rh, gate

@@ -3,9 +3,11 @@ from .nn import *            # noqa: F401,F403
 from .tensor import (create_tensor, create_global_var, create_parameter,  # noqa
                      fill_constant, fill_constant_batch_size_like, assign,
                      concat, sums, argmax, argmin, argsort, ones, zeros,
-                     ones_like, zeros_like, linspace, diag, eye)
+                     ones_like, zeros_like, linspace, diag, eye, isfinite,
+                     has_nan, has_inf, reverse, tensor_array_to_tensor)
 from .tensor import range as range_  # noqa: F401  (avoid shadowing builtin at import *)
-from .io import data  # noqa: F401
+from .io import (data, double_buffer, py_reader,  # noqa: F401
+                 create_py_reader_by_data, load, read_file)
 from . import learning_rate_scheduler  # noqa: F401
 from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
                                       natural_exp_decay, inverse_time_decay,

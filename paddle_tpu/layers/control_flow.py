@@ -16,8 +16,10 @@ from ..layer_helper import LayerHelper
 from . import tensor
 
 __all__ = ["increment", "array_write", "array_read", "array_length",
-           "create_array", "less_than", "equal", "Scan", "While", "Switch",
-           "IfElse", "DynamicRNN"]
+           "create_array", "less_than", "equal", "greater_than",
+           "greater_equal", "less_equal", "not_equal", "is_empty", "Print",
+           "Scan", "StaticRNN", "While", "Switch", "IfElse", "DynamicRNN",
+           "reorder_lod_tensor_by_rank"]
 
 
 def _outer_writes(program, root_idx, parent):
@@ -704,3 +706,60 @@ class DynamicRNN:
 
     def __call__(self):
         return self._scan()
+
+
+def _cmp_layer(op_type):
+    def layer(x, y, cond=None):
+        helper = LayerHelper(op_type)
+        if cond is None:
+            cond = helper.create_variable_for_type_inference(
+                "bool", stop_gradient=True)
+        helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [cond]})
+        return helper.main_program.current_block().var(cond.name)
+    layer.__name__ = op_type
+    return layer
+
+
+greater_than = _cmp_layer("greater_than")
+greater_equal = _cmp_layer("greater_equal")
+less_equal = _cmp_layer("less_equal")
+not_equal = _cmp_layer("not_equal")
+
+
+def is_empty(x, cond=None):
+    """Reference control_flow.py:is_empty. Shapes are static under XLA, so
+    emptiness is a compile-time fact materialized as a constant."""
+    empty = any(s == 0 for s in x.shape)
+    out = tensor.fill_constant([1], "bool", 1.0 if empty else 0.0)
+    if cond is not None:
+        tensor.assign(out, cond)
+        return cond
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Reference control_flow.py:Print -- host-side debug print via the
+    print op (jax.debug.print under jit)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or (input.name + ": ")})
+    return helper.main_program.current_block().var(out.name)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    raise NotImplementedError(
+        "reorder_lod_tensor_by_rank reorders ragged LoD rows by a rank "
+        "table; the TPU representation is padded+lengths (SCOPE.md LoD row) "
+        "-- sort/gather the padded batch with argsort + gather instead")
+
+
+# StaticRNN: Scan was designed as its TPU-native analog -- same
+# step_input/memory/update_memory/step_output protocol over lax.scan
+# (reference control_flow.py:478). The alias keeps ported code working.
+StaticRNN = Scan

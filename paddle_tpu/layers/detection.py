@@ -8,8 +8,10 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
-           "multiclass_nms", "roi_align", "roi_pool", "anchor_generator",
-           "box_clip", "bipartite_match", "target_assign", "ssd_loss"]
+           "multiclass_nms", "multiclass_nms2", "roi_align", "roi_pool",
+           "anchor_generator", "box_clip", "bipartite_match",
+           "target_assign", "ssd_loss", "sigmoid_focal_loss",
+           "detection_output", "density_prior_box"]
 
 
 def _out(helper, dtype="float32", stop_gradient=False):
@@ -228,3 +230,76 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         denom = _nn.scale(_nn.reduce_sum(loc_w), 1.0, bias=1e-6)
         total = _nn.elementwise_div(total, denom)
     return total
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """Reference detection.py:sigmoid_focal_loss (RetinaNet): per-class
+    sigmoid CE with focal modulation, normalized by foreground count.
+    x [N, C] logits; label [N, 1] int (0 = background); fg_num [1] int."""
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = _out(helper, x.dtype)
+    helper.append_op("sigmoid_focal_loss",
+                     inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+                     outputs={"Out": [out]},
+                     attrs={"gamma": float(gamma), "alpha": float(alpha)})
+    return helper.main_program.current_block().var(out.name)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var=None,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """Reference detection.py:detection_output = decode + multiclass NMS
+    (the SSD inference head)."""
+    from . import nn as _nn
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    if len(decoded.shape) == 2:
+        decoded = _nn.reshape(decoded, [1] + [int(s) for s in decoded.shape])
+    if len(scores.shape) == 2:
+        scores = _nn.reshape(_nn.transpose(scores, [1, 0]),
+                             [1, int(scores.shape[1]), int(scores.shape[0])])
+    if return_index:
+        # reference contract: the second output is the kept boxes' INDEX
+        # into the prior list, not the counts
+        return multiclass_nms2(decoded, scores, score_threshold, nms_top_k,
+                               keep_top_k, nms_threshold, True, nms_eta,
+                               background_label, return_index=True)
+    out, _ = multiclass_nms(decoded, scores, score_threshold, nms_top_k,
+                            keep_top_k, nms_threshold, True, nms_eta,
+                            background_label)
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """Reference multiclass_nms2: multiclass_nms that can also return the
+    kept boxes' indices into the input box list (-1 padding)."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    out = _out(helper, bboxes.dtype, stop_gradient=True)
+    idx = _out(helper, "int64", stop_gradient=True)
+    num = _out(helper, "int64", stop_gradient=True)
+    helper.append_op("multiclass_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out], "Index": [idx],
+                              "NmsRoisNum": [num]},
+                     attrs={"score_threshold": float(score_threshold),
+                            "nms_top_k": int(nms_top_k),
+                            "keep_top_k": int(keep_top_k),
+                            "nms_threshold": float(nms_threshold),
+                            "normalized": bool(normalized),
+                            "nms_eta": float(nms_eta),
+                            "background_label": int(background_label)})
+    blk = helper.main_program.current_block()
+    if return_index:
+        return blk.var(out.name), blk.var(idx.name)
+    return blk.var(out.name)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False, steps=(0, 0),
+                      offset=0.5, flatten_to_2d=False, name=None):
+    raise NotImplementedError(
+        "density_prior_box: the SSDLite density grid; use prior_box / "
+        "anchor_generator (COVERAGE.md detection row -- add on demand)")

@@ -19,3 +19,70 @@ def data(name, shape, dtype="float32", type=None, append_batch_size=True,
                          stop_gradient=stop_gradient)
     v.is_data = True
     return v
+
+
+def double_buffer(reader, place=None, name=None):
+    """Reference layers/io.py:double_buffer. The DataLoader already stages
+    the next batch on device while the step runs (reader.py producer thread
+    + jax.device_put), so this is the identity -- kept so ported pipelines
+    build unchanged."""
+    return reader
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Reference layers/io.py:py_reader. Returns a PyReader-style loader;
+    declare feed vars matching shapes/dtypes and iterate the loader for feed
+    dicts (the decorate_* methods match the reference)."""
+    from ..reader import PyReader
+    from ..framework import default_main_program
+    block = default_main_program().current_block()
+    feed_vars = []
+    from .. import unique_name
+    for i, (shp, dt) in enumerate(zip(shapes, dtypes)):
+        v = block.create_var(unique_name.generate(f"py_reader_{i}"),
+                             tuple(shp), dt)
+        v.is_data = True
+        feed_vars.append(v)
+    loader = PyReader(feed_vars, capacity=capacity,
+                      use_double_buffer=use_double_buffer)
+    loader.feed_vars = feed_vars
+    return loader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """Reference layers/io.py:create_py_reader_by_data."""
+    from ..reader import PyReader
+    return PyReader(feed_list, capacity=capacity,
+                    use_double_buffer=use_double_buffer)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Reference layers/io.py:load -- load ONE whole-var .npy into ``out``'s
+    scope slot. Shard chunks of the io.py checkpoint format (*.r<k>c<i>.npy)
+    are partial regions in storage dtype -- use io.load_vars/load_persistables
+    for those; this fn refuses them rather than set partial data."""
+    import re
+    import numpy as np
+    from ..core.executor import global_scope
+    if re.search(r"\.r\d+c\d+\.npy$", file_path):
+        raise ValueError(
+            f"{file_path!r} is a shard chunk of a sharded checkpoint; load "
+            f"the checkpoint with fluid.io.load_vars/load_persistables")
+    arr = np.load(file_path, allow_pickle=False)
+    global_scope().set_var(out.name if hasattr(out, "name") else str(out),
+                           arr)
+    return out
+
+
+def read_file(reader):
+    """Reference layers/io.py:read_file. The DataLoader yields feed dicts
+    directly (no graph-side reader op); returns the loader's feed vars so
+    reference-shaped `img, label = fluid.layers.read_file(reader)` works."""
+    fv = getattr(reader, "feed_vars", None) or getattr(reader, "feed_list",
+                                                       None)
+    if fv is None:
+        raise ValueError("read_file expects a DataLoader/PyReader "
+                         "(feeds by name; no reader op exists)")
+    return list(fv)

@@ -207,3 +207,56 @@ def reverse(x, axis):
                      attrs={"axis": axis if isinstance(axis, (list, tuple))
                             else [axis]})
     return helper.main_program.current_block().var(out.name)
+
+
+def isfinite(x):
+    """Reference tensor.py:isfinite -- scalar [1] bool-ish all-finite check."""
+    helper = LayerHelper("isfinite")
+    out = _out(helper, "bool", stop_gradient=True)
+    helper.append_op("isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return helper.main_program.current_block().var(out.name)
+
+
+def has_nan(x):
+    from . import nn as _nn
+    from .control_flow import equal
+    from .extras import logical_not
+    # any(x != x) is the NaN test; finite check excludes inf
+    neq = _nn.cast(logical_not(equal(x, x)), "float32")
+    s = _nn.reduce_sum(neq)
+    return _nn.cast(_nn.reshape(s, [1]), "bool")
+
+
+def has_inf(x):
+    from . import nn as _nn
+    from .control_flow import equal
+    # |x| == inf elementwise: inf is detected even when NaNs coexist
+    inf = fill_constant([1], x.dtype, float("inf"))
+    eq = _nn.cast(equal(_nn.abs(x), inf), "float32")
+    return _nn.cast(_nn.reshape(_nn.reduce_sum(eq), [1]), "bool")
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = _out(helper, x.dtype)
+    helper.append_op("reverse", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": list(axis) if isinstance(
+                         axis, (list, tuple)) else [axis]})
+    return helper.main_program.current_block().var(out.name)
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """Reference tensor.py:tensor_array_to_tensor: concatenate a TensorArray
+    along ``axis``. Our arrays are fixed-capacity stacked buffers, so this
+    reads every slot and concats; returns (out, per-slot sizes) like the
+    reference's (Out, OutIndex)."""
+    import builtins
+    from .control_flow import array_read
+    cap = int(input.shape[0])
+    reads = [array_read(input, fill_constant([1], "int32", t))
+             for t in builtins.range(cap)]   # module-level range() shadows
+    out = concat(reads, axis=axis)
+    sizes = fill_constant([cap], "int32",
+                          float(reads[0].shape[axis]
+                                if reads[0].shape[axis] != -1 else 1))
+    return out, sizes

@@ -221,6 +221,7 @@ def multiclass_nms(ctx, ins):
         best, sel = jax.lax.top_k(flat_scores, Kk)
         valid = best > -jnp.inf
         lab = jnp.where(valid, flat_labels[sel], -1).astype(jnp.float32)
+        kept_box_idx = jnp.where(valid, flat_idx[sel], -1).astype(jnp.int32)
         bx = img_boxes[flat_idx[sel]]
         row = jnp.concatenate([lab[:, None],
                                jnp.where(valid, best, 0.0)[:, None],
@@ -228,10 +229,13 @@ def multiclass_nms(ctx, ins):
         if Kk < keep_top_k:
             pad = jnp.zeros((keep_top_k - Kk, 6), row.dtype).at[:, 0].set(-1)
             row = jnp.concatenate([row, pad], 0)
-        return row, jnp.sum(valid.astype(jnp.int32))
+            kept_box_idx = jnp.concatenate(
+                [kept_box_idx, jnp.full((keep_top_k - Kk,), -1, jnp.int32)])
+        return row, kept_box_idx, jnp.sum(valid.astype(jnp.int32))
 
-    out, num = jax.vmap(per_image)(bboxes, scores)
-    return {"Out": [out], "NmsRoisNum": [num.astype("int64")]}
+    out, index, num = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out], "Index": [index.astype("int64")],
+            "NmsRoisNum": [num.astype("int64")]}
 
 
 @register("roi_align", nondiff_inputs=("ROIs", "RoisNum"))
@@ -445,3 +449,25 @@ def target_assign(ctx, ins):
     out = jnp.where(matched, out, mismatch_value)
     w = matched.astype(x.dtype)
     return {"Out": [out], "OutWeight": [w]}
+
+
+@register("sigmoid_focal_loss", nondiff_inputs=("Label", "FgNum"))
+def sigmoid_focal_loss(ctx, ins):
+    """RetinaNet focal loss (detection/sigmoid_focal_loss_op.cu math):
+    class j is positive for a row iff label == j+1 (0 = background)."""
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]                                     # [N, C]
+    label = ins["Label"][0].reshape(-1).astype("int32") # [N]
+    fg = jnp.maximum(ins["FgNum"][0].reshape(()).astype(jnp.float32), 1.0)
+    gamma = float(ctx.attr("gamma", 2.0))
+    alpha = float(ctx.attr("alpha", 0.25))
+    C = x.shape[-1]
+    pos = jax.nn.one_hot(label - 1, C, dtype=x.dtype)   # bg -> all zeros
+    p = jax.nn.sigmoid(x)
+    # numerically-stable log-sigmoid forms
+    log_p = jax.nn.log_sigmoid(x)
+    log_1p = jax.nn.log_sigmoid(-x)
+    loss = -(pos * alpha * ((1 - p) ** gamma) * log_p +
+             (1 - pos) * (1 - alpha) * (p ** gamma) * log_1p)
+    return {"Out": [loss / fg]}

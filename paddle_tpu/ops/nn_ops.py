@@ -78,10 +78,17 @@ def conv2d_transpose(ctx, ins):
         # paddle/torch kernel layout [in_c, out_c, kh, kw]: with
         # transpose_kernel=True jax wants it marked as the FORWARD conv's
         # kernel, i.e. O=in_c I=out_c -> "OIHW" (IOHW only shape-checks when
-        # in_c == out_c, and silently computes the wrong transpose even then)
+        # in_c == out_c, and silently computes the wrong transpose even then).
+        # lax padding = d*(k-1) - p (paddle/torch p crops the output; the
+        # effective dilated kernel is d*(k-1)+1). The two only coincide at
+        # p == (k-1)/2, d=1 -- why odd-kernel same-pad tests used to pass.
+        # Verified vs torch for k in {2,3,4,5} and dilation {1,2}.
+        kh, kw = wg.shape[2], wg.shape[3]
+        ph = dil[0] * (kh - 1) - pads[0]
+        pw = dil[1] * (kw - 1) - pads[1]
         return lax.conv_transpose(
             xg, wg, strides=strides,
-            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            padding=[(ph, ph), (pw, pw)],
             rhs_dilation=dil,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             transpose_kernel=True)
@@ -369,8 +376,11 @@ def conv3d_transpose(ctx, ins):
     groups = ctx.attr("groups", 1) or 1
 
     def conv1(xg, wg):
+        ks = wg.shape[2:]
         return lax.conv_transpose(
-            xg, wg, strides=strides, padding=[(p, p) for p in pads],
+            xg, wg, strides=strides,
+            padding=[(d * (k - 1) - p, d * (k - 1) - p)
+                     for k, p, d in zip(ks, pads, dil)],
             rhs_dilation=dil, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
             transpose_kernel=True)
 

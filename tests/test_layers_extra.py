@@ -577,3 +577,27 @@ def test_grid_sampler_identity():
         return [layers.grid_sampler(xv, grid)]
     out, = _run(build, {"x": x, "t": theta})
     np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_even_kernel_matches_torch():
+    """Regression: paddle padding maps to lax as k-1-p; even kernels (k=4,
+    the GAN/upsampler staple) used to come out 2px short."""
+    import torch
+    rng = np.random.RandomState(21)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(3, 6, 4, 4).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.data("x", [3, 8, 8], "float32")
+        out = fluid.layers.conv2d_transpose(
+            xv, 6, filter_size=4, stride=2, padding=1, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="ctw4"))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope().set_var("ctw4", w)
+        got, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    want = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    assert got.shape == want.shape == (2, 6, 16, 16)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,229 @@
+"""Round-3 parity sweep: tensor/control_flow/io/detection layer additions
+and the new dygraph classes all build, run, and give sane numerics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph, layers
+
+
+def _run(build, feed, startup_too=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        if startup_too:
+            exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+def test_tensor_additions():
+    x = np.array([[1.0, 2.0], [3.0, np.inf]], "float32")
+    n = np.array([[1.0, np.nan]], "float32")
+
+    def build():
+        xv = fluid.data("x", [2], "float32")
+        nv = fluid.data("n", [2], "float32")
+        return [layers.isfinite(xv), layers.has_inf(xv), layers.has_nan(xv),
+                layers.has_nan(nv), layers.reverse(xv, axis=1)]
+    fin, hinf, hnan_x, hnan_n, rev = _run(build, {"x": x, "n": n})
+    assert not bool(fin[0]) and bool(hinf[0]) and not bool(hnan_x[0])
+    assert bool(hnan_n[0])
+    np.testing.assert_array_equal(rev, x[:, ::-1])
+
+
+def test_tensor_array_to_tensor():
+    def build():
+        arr = layers.create_array("float32", capacity=3)
+        for t in range(3):
+            v = fluid.layers.fill_constant([2, 4], "float32", float(t))
+            layers.array_write(v, fluid.layers.fill_constant([1], "int32",
+                                                             float(t)),
+                               array=arr)
+        out, sizes = layers.tensor_array_to_tensor(arr, axis=1)
+        return [out, sizes]
+    out, sizes = _run(build, {})
+    assert out.shape == (2, 12)
+    np.testing.assert_allclose(out[0, :4], 0.0)
+    np.testing.assert_allclose(out[0, 8:], 2.0)
+
+
+def test_cmp_layers_and_is_empty_and_print():
+    def build():
+        a = fluid.layers.fill_constant([2], "float32", 1.0)
+        b = fluid.layers.fill_constant([2], "float32", 2.0)
+        gt = layers.greater_than(b, a)
+        ge = layers.greater_equal(a, a)
+        le = layers.less_equal(a, b)
+        ne = layers.not_equal(a, b)
+        emp = layers.is_empty(a)
+        p = layers.Print(a, message="dbg: ")
+        return [gt, ge, le, ne, emp, p]
+    gt, ge, le, ne, emp, p = _run(build, {})
+    assert gt.all() and ge.all() and le.all() and ne.all()
+    assert not emp[0]
+    assert layers.StaticRNN is layers.Scan
+
+
+def test_detection_output_and_focal_loss():
+    rng = np.random.RandomState(0)
+    M, C = 8, 3
+    prior = np.sort(rng.rand(M, 2) * 40, 0)
+    prior = np.concatenate([prior, prior + 6], 1).astype("float32")
+
+    def build():
+        A = dict(append_batch_size=False)
+        loc = fluid.data("loc", [M, 4], "float32", **A)
+        sc = fluid.data("sc", [M, C], "float32", **A)
+        pb = fluid.layers.assign(prior)
+        out = layers.detection_output(loc, sc, pb, nms_threshold=0.5,
+                                      score_threshold=0.1, keep_top_k=5)
+        x = fluid.data("x", [4, C], "float32", **A)
+        lab = fluid.data("lab", [4, 1], "int64", **A)
+        fg = fluid.data("fg", [1], "int32", **A)
+        fl = layers.sigmoid_focal_loss(x, lab, fg)
+        return [out, fl]
+    out, fl = _run(build, {
+        "loc": (rng.randn(M, 4) * 0.1).astype("float32"),
+        "sc": rng.rand(M, C).astype("float32"),
+        "x": rng.randn(4, C).astype("float32"),
+        "lab": np.array([[0], [1], [2], [3]], "int64"),
+        "fg": np.array([3], "int32")})
+    assert out.shape == (1, 5, 6)
+    assert fl.shape == (4, C) and np.isfinite(fl).all() and (fl >= 0).all()
+    # background row (label 0) must have no positive-class term dominating:
+    # its loss should be the all-negative form (small for small logits)
+    with pytest.raises(NotImplementedError):
+        layers.density_prior_box(None, None, None, None, None)
+
+
+def test_io_facades():
+    loader = layers.py_reader(capacity=2, shapes=[[-1, 4], [-1, 1]],
+                              dtypes=["float32", "int64"])
+    vars_ = layers.read_file(loader)
+    assert len(vars_) == 2 and vars_[0].shape == (-1, 4)
+    assert layers.double_buffer(loader) is loader
+
+    def gen():
+        for i in range(3):
+            yield (np.full((2, 4), i, "float32"), np.zeros((2, 1), "int64"))
+    loader.decorate_batch_generator(gen)
+    seen = [np.asarray(b[vars_[0].name])[0, 0] for b in loader]
+    assert seen == [0.0, 1.0, 2.0]
+
+
+def test_dygraph_new_layers():
+    rng = np.random.RandomState(1)
+    with dygraph.guard():
+        x = dygraph.to_variable(rng.randn(2, 3, 8, 8).astype("float32"))
+        ct = dygraph.Conv2DTranspose(3, 6, 3, stride=2, padding=1)
+        assert ct(x).shape == (2, 6, 15, 15)
+        v = dygraph.to_variable(rng.randn(2, 2, 4, 8, 8).astype("float32"))
+        c3 = dygraph.Conv3D(2, 4, 3, padding=1)
+        assert c3(v).shape == (2, 4, 4, 8, 8)
+        gn = dygraph.GroupNorm(6, groups=3)
+        y = gn(ct(x))
+        assert y.shape == (2, 6, 15, 15)
+        pr = dygraph.PRelu("all")
+        assert pr(x).shape == x.shape
+        btp = dygraph.BilinearTensorProduct(4, 5, 3)
+        a = dygraph.to_variable(rng.randn(2, 4).astype("float32"))
+        b = dygraph.to_variable(rng.randn(2, 5).astype("float32"))
+        assert btp(a, b).shape == (2, 3)
+        rc = dygraph.RowConv(4, 2)
+        seq = dygraph.to_variable(rng.randn(2, 6, 4).astype("float32"))
+        assert rc(seq).shape == (2, 6, 4)
+        gu = dygraph.GRUUnit(12)
+        gate = dygraph.to_variable(rng.randn(2, 12).astype("float32"))
+        h = dygraph.to_variable(rng.randn(2, 4).astype("float32"))
+        nh, rh, g = gu(gate, h)
+        assert nh.shape == (2, 4) and g.shape == (2, 12)
+        # trains: grads reach the new layers' params
+        loss = dygraph.trace_op("mean", {"X": [btp(a, b) * btp(a, b)]}, {},
+                                ["Out"])["Out"][0]
+        loss.backward()
+        assert btp.weight.gradient() is not None
+
+
+def test_conv2d_transpose_dilation_matches_torch():
+    import torch
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 2, 7, 7).astype("float32")
+    w = rng.randn(2, 3, 3, 3).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.data("x", [2, 7, 7], "float32")
+        out = fluid.layers.conv2d_transpose(
+            xv, 3, filter_size=3, stride=1, padding=1, dilation=2,
+            bias_attr=False, param_attr=fluid.ParamAttr(name="ctd"))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope().set_var("ctd", w)
+        got, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    want = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=1, padding=1,
+        dilation=2).numpy()
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_unit_matches_numpy():
+    """GRUUnit recurrence vs a manual numpy GRU (gru_unit_op.h math):
+    u,r = sig(x_ur + h@W_ur + b_ur); c = tanh(x_c + (r*h)@W_c + b_c);
+    nh = u*h + (1-u)*c."""
+    rng = np.random.RandomState(6)
+    H = 4
+    with dygraph.guard():
+        gu = dygraph.GRUUnit(3 * H)
+        gate = rng.randn(2, 3 * H).astype("float32")
+        h = rng.randn(2, H).astype("float32")
+        nh, rh, g = gu(dygraph.to_variable(gate), dygraph.to_variable(h))
+        W = gu.weight.numpy()
+        b = gu.bias.numpy()
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    ur = sig(gate[:, :2 * H] + h @ W[:, :2 * H] + b[:2 * H])
+    u, r = ur[:, :H], ur[:, H:]
+    c = np.tanh(gate[:, 2 * H:] + (r * h) @ W[:, 2 * H:] + b[2 * H:])
+    want = u * h + (1 - u) * c
+    np.testing.assert_allclose(nh.numpy(), want, rtol=1e-5, atol=1e-6)
+    # origin_mode flips the mix
+    with dygraph.guard():
+        gu2 = dygraph.GRUUnit(3 * H, origin_mode=True)
+        nh2, _, _ = gu2(dygraph.to_variable(gate), dygraph.to_variable(h))
+        W2, b2 = gu2.weight.numpy(), gu2.bias.numpy()
+    ur2 = sig(gate[:, :2 * H] + h @ W2[:, :2 * H] + b2[:2 * H])
+    u2, r2 = ur2[:, :H], ur2[:, H:]
+    c2 = np.tanh(gate[:, 2 * H:] + (r2 * h) @ W2[:, 2 * H:] + b2[2 * H:])
+    np.testing.assert_allclose(nh2.numpy(), (1 - u2) * h + u2 * c2,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_has_inf_with_coexisting_nan():
+    bad = np.array([[np.inf, np.nan]], "float32")
+
+    def build():
+        xv = fluid.data("x", [2], "float32")
+        return [layers.has_inf(xv), layers.has_nan(xv)]
+    hinf, hnan = _run(build, {"x": bad})
+    assert bool(hinf[0]) and bool(hnan[0])
+
+
+def test_multiclass_nms2_returns_box_indices():
+    boxes = np.array([[[0, 0, 5, 5], [10, 10, 15, 15], [0, 0, 5.2, 5.2]]],
+                     "float32")
+    scores = np.zeros((1, 2, 3), "float32")
+    scores[0, 1] = [0.9, 0.8, 0.85]
+
+    def build():
+        bv = fluid.data("b", [3, 4], "float32")
+        sv = fluid.data("s", [2, 3], "float32")
+        out, idx = layers.multiclass_nms2(
+            bv, sv, score_threshold=0.1, nms_top_k=3, keep_top_k=3,
+            nms_threshold=0.5, return_index=True)
+        return [out, idx]
+    out, idx = _run(build, {"b": boxes, "s": scores})
+    # box 2 suppressed by box 0 (IoU > .5); kept = 0 (score .9), 1 (.8)
+    kept = sorted(int(i) for i in idx[0] if i >= 0)
+    assert kept == [0, 1], idx
