@@ -482,5 +482,6 @@ class GRUUnit(Layer):
                  "Y": [op("elementwise_mul", {"X": [b], "Y": [c]},
                           {"axis": -1})]},
                 {"axis": -1})
-        gate = op("concat", {"X": [ur_in, c_in]}, {"axis": 1})
+        # reference gru_unit_op.h stores the ACTIVATED gates in Gate
+        gate = op("concat", {"X": [u, r, c]}, {"axis": 1})
         return nh, rh, gate

@@ -729,7 +729,13 @@ not_equal = _cmp_layer("not_equal")
 
 def is_empty(x, cond=None):
     """Reference control_flow.py:is_empty. Shapes are static under XLA, so
-    emptiness is a compile-time fact materialized as a constant."""
+    emptiness is a compile-time fact materialized as a constant; a dynamic
+    (-1) dim has no build-time answer and raises rather than guessing."""
+    if any(s == -1 for s in x.shape):
+        raise ValueError(
+            f"is_empty({x.name}): shape {x.shape} has a dynamic dim; "
+            f"emptiness is only decidable for static shapes under XLA -- "
+            f"guard with a host-side check on the feed instead")
     empty = any(s == 0 for s in x.shape)
     out = tensor.fill_constant([1], "bool", 1.0 if empty else 0.0)
     if cond is not None:

@@ -11,7 +11,7 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
            "multiclass_nms", "multiclass_nms2", "roi_align", "roi_pool",
            "anchor_generator", "box_clip", "bipartite_match",
            "target_assign", "ssd_loss", "sigmoid_focal_loss",
-           "detection_output", "density_prior_box"]
+           "detection_output", "density_prior_box", "generate_proposals", "rpn_target_assign"]
 
 
 def _out(helper, dtype="float32", stop_gradient=False):
@@ -256,9 +256,14 @@ def detection_output(loc, scores, prior_box, prior_box_var=None,
                         code_type="decode_center_size")
     if len(decoded.shape) == 2:
         decoded = _nn.reshape(decoded, [1] + [int(s) for s in decoded.shape])
-    if len(scores.shape) == 2:
+    # reference detection.py:detection_output applies softmax over classes
+    # and feeds NMS [N, C, M]; accept the reference's [N, M, C] (or [M, C])
+    scores = _nn.softmax(scores)
+    if len(scores.shape) == 2:                       # [M, C] -> [1, C, M]
         scores = _nn.reshape(_nn.transpose(scores, [1, 0]),
                              [1, int(scores.shape[1]), int(scores.shape[0])])
+    else:                                            # [N, M, C] -> [N, C, M]
+        scores = _nn.transpose(scores, [0, 2, 1])
     if return_index:
         # reference contract: the second output is the kept boxes' INDEX
         # into the prior list, not the counts
@@ -303,3 +308,77 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
     raise NotImplementedError(
         "density_prior_box: the SSDLite density grid; use prior_box / "
         "anchor_generator (COVERAGE.md detection row -- add on demand)")
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=True, name=None):
+    """Reference detection.py:generate_proposals. Fixed-shape outputs:
+    (rois [N, post_nms_top_n, 4], roi_probs [N, post_nms_top_n, 1],
+    rois_num [N]) -- padded + counts replaces the ragged LoD."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = _out(helper, scores.dtype, stop_gradient=True)
+    probs = _out(helper, scores.dtype, stop_gradient=True)
+    num = _out(helper, "int64", stop_gradient=True)
+    helper.append_op("generate_proposals",
+                     inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                             "ImInfo": [im_info], "Anchors": [anchors],
+                             "Variances": [variances]},
+                     outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                              "RpnRoisNum": [num]},
+                     attrs={"pre_nms_topN": int(pre_nms_top_n),
+                            "post_nms_topN": int(post_nms_top_n),
+                            "nms_thresh": float(nms_thresh),
+                            "min_size": float(min_size)})
+    blk = helper.main_program.current_block()
+    if return_rois_num:
+        return blk.var(rois.name), blk.var(probs.name), blk.var(num.name)
+    return blk.var(rois.name), blk.var(probs.name)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """Reference detection.py:288. Fixed-shape form: returns per-anchor
+    (score_pred, loc_pred, score_target, loc_target, bbox_inside_weight)
+    with ignore rows weighted 0 instead of the reference's 256-sample
+    gather (see op docstring for the deviation rationale)."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+    from .control_flow import equal
+    helper = LayerHelper("rpn_target_assign")
+    labels = _out(helper, "int32", stop_gradient=True)
+    matched = _out(helper, "int32", stop_gradient=True)
+    tgt = _out(helper, anchor_box.dtype, stop_gradient=True)
+    helper.append_op("rpn_target_assign",
+                     inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+                     outputs={"Labels": [labels], "MatchedGt": [matched],
+                              "BboxTargets": [tgt]},
+                     attrs={"rpn_positive_overlap": float(
+                                rpn_positive_overlap),
+                            "rpn_negative_overlap": float(
+                                rpn_negative_overlap)})
+    blk = helper.main_program.current_block()
+    labels = blk.var(labels.name)
+    tgt = blk.var(tgt.name)
+    pos_mask = _tensor.cast(
+        equal(labels, _tensor.fill_constant([1], "int32", 1)), "float32")
+    # ignore rows (-1) must not leak into the classification loss: their
+    # logits are zero-masked (zero GRADIENT through the multiply) and their
+    # targets forced to 0.5 = sigmoid(0) so the residual is zero too. The
+    # reference gathers sampled anchors instead -- fixed shapes can't.
+    from .extras import logical_not
+    valid = _tensor.cast(
+        logical_not(equal(labels,
+                          _tensor.fill_constant([1], "int32", -1))),
+        "float32")
+    valid = _nn.reshape(valid, [-1, 1])
+    score_pred = _nn.elementwise_mul(cls_logits, valid)
+    score_tgt = _nn.elementwise_add(
+        _nn.elementwise_mul(_nn.reshape(pos_mask, [-1, 1]), valid),
+        _nn.scale(_nn.scale(valid, -1.0, bias=1.0), 0.5))
+    inside_w = _nn.reshape(pos_mask, [-1, 1])
+    return (score_pred, bbox_pred, score_tgt, tgt, inside_w)

@@ -236,15 +236,6 @@ def has_inf(x):
     return _nn.cast(_nn.reshape(_nn.reduce_sum(eq), [1]), "bool")
 
 
-def reverse(x, axis):
-    helper = LayerHelper("reverse")
-    out = _out(helper, x.dtype)
-    helper.append_op("reverse", inputs={"X": [x]}, outputs={"Out": [out]},
-                     attrs={"axis": list(axis) if isinstance(
-                         axis, (list, tuple)) else [axis]})
-    return helper.main_program.current_block().var(out.name)
-
-
 def tensor_array_to_tensor(input, axis=1, name=None):
     """Reference tensor.py:tensor_array_to_tensor: concatenate a TensorArray
     along ``axis``. Our arrays are fixed-capacity stacked buffers, so this

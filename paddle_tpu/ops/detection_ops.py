@@ -471,3 +471,134 @@ def sigmoid_focal_loss(ctx, ins):
     loss = -(pos * alpha * ((1 - p) ** gamma) * log_p +
              (1 - pos) * (1 - alpha) * (p ** gamma) * log_1p)
     return {"Out": [loss / fg]}
+
+
+@register("generate_proposals", grad=None,
+          nondiff_inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                          "Variances"))
+def generate_proposals(ctx, ins):
+    """RPN proposal generation (detection/generate_proposals_op.cc):
+    decode anchor deltas -> clip to image -> filter tiny boxes -> pre-NMS
+    top-k -> NMS -> post-NMS top-k. Fixed-shape: outputs are padded to
+    post_nms_topN with a validity count (the ragged LoD output becomes
+    padded + RpnRoisNum, same convention as multiclass_nms).
+
+    Scores [N, A, H, W]; BboxDeltas [N, 4A, H, W]; Anchors [H, W, A, 4];
+    Variances like Anchors; ImInfo [N, 3].
+    """
+    import jax
+    jnp = _jnp()
+    scores = ins["Scores"][0]
+    deltas = ins["BboxDeltas"][0]
+    im_info = ins["ImInfo"][0]
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4)
+    pre_n = int(ctx.attr("pre_nms_topN", 6000))
+    post_n = int(ctx.attr("post_nms_topN", 1000))
+    nms_thresh = float(ctx.attr("nms_thresh", 0.7))
+    min_size = float(ctx.attr("min_size", 0.1))
+    N, A = scores.shape[0], scores.shape[1]
+    HW = scores.shape[2] * scores.shape[3]
+    M = A * HW
+
+    def per_image(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(-1)                # [H*W*A]
+        d = dl.reshape(A, 4, *dl.shape[1:]).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # anchors come in [H, W, A, 4] flattened the same H,W,A order
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = anchors[:, 0] + 0.5 * aw
+        acy = anchors[:, 1] + 0.5 * ah
+        dv = d * variances
+        cx = acx + dv[:, 0] * aw
+        cy = acy + dv[:, 1] * ah
+        w = jnp.exp(jnp.minimum(dv[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(dv[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=1)
+        # clip to image (im_info = h, w, scale)
+        hm, wm = info[0] - 1.0, info[1] - 1.0
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, wm),
+                           jnp.clip(boxes[:, 1], 0, hm),
+                           jnp.clip(boxes[:, 2], 0, wm),
+                           jnp.clip(boxes[:, 3], 0, hm)], axis=1)
+        ms = min_size * info[2]
+        keepable = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms) &
+                    (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        s = jnp.where(keepable, s, -jnp.inf)
+        K = min(pre_n, M)
+        top_s, order = jax.lax.top_k(s, K)
+        cand = boxes[order]
+        iou = _iou_matrix(cand, cand, 1.0)
+
+        def step(kept, i):
+            over = (iou[i] > nms_thresh) & kept & (jnp.arange(K) < i)
+            ok = (~over.any()) & (top_s[i] > -jnp.inf)
+            return kept.at[i].set(ok), ok
+
+        _, keep = jax.lax.scan(step, jnp.zeros((K,), bool), jnp.arange(K))
+        sel_s = jnp.where(keep, top_s, -jnp.inf)
+        P = min(post_n, K)
+        best, sel = jax.lax.top_k(sel_s, P)
+        valid = best > -jnp.inf
+        out_boxes = jnp.where(valid[:, None], cand[sel], 0.0)
+        out_scores = jnp.where(valid, best, 0.0)
+        if P < post_n:
+            out_boxes = jnp.concatenate(
+                [out_boxes, jnp.zeros((post_n - P, 4), out_boxes.dtype)])
+            out_scores = jnp.concatenate(
+                [out_scores, jnp.zeros((post_n - P,), out_scores.dtype)])
+            valid = jnp.concatenate([valid, jnp.zeros((post_n - P,), bool)])
+        return out_boxes, out_scores, jnp.sum(valid.astype(jnp.int32))
+
+    rois, rscores, num = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [rscores[..., None]],
+            "RpnRoisNum": [num.astype("int64")]}
+
+
+@register("rpn_target_assign", grad=None,
+          nondiff_inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"))
+def rpn_target_assign(ctx, ins):
+    """RPN anchor labeling (detection/rpn_target_assign_op.cc): positives =
+    best-anchor-per-gt plus IoU >= positive_overlap; negatives = IoU <
+    negative_overlap; the rest ignored. The reference then RANDOM-samples
+    batch_size_per_im anchors; the fixed-shape form keeps ALL labeled
+    anchors with +/-1/0 weights (sampling on TPU would need a fixed count
+    anyway -- weighting by label is the shape-stable equivalent, documented
+    deviation; use_random is accepted and ignored).
+
+    Anchor [M, 4]; GtBoxes [G, 4]. Outputs: Labels [M] (1 fg / 0 bg /
+    -1 ignore), MatchedGt [M] gt index, BboxTargets [M, 4] encoded deltas.
+    """
+    jnp = _jnp()
+    anchors = ins["Anchor"][0]
+    gt = ins["GtBoxes"][0]
+    pos_ov = float(ctx.attr("rpn_positive_overlap", 0.7))
+    neg_ov = float(ctx.attr("rpn_negative_overlap", 0.3))
+    iou = _iou_matrix(gt, anchors)                     # [G, M]
+    best_per_anchor = jnp.max(iou, axis=0)             # [M]
+    arg_gt = jnp.argmax(iou, axis=0).astype("int32")
+    # force-positive: the best anchor for every gt
+    best_per_gt = jnp.max(iou, axis=1, keepdims=True)  # [G, 1]
+    is_best_for_some_gt = jnp.any(
+        (iou >= best_per_gt) & (best_per_gt > 0), axis=0)
+    pos = (best_per_anchor >= pos_ov) | is_best_for_some_gt
+    neg = (best_per_anchor < neg_ov) & ~pos
+    labels = jnp.where(pos, 1, jnp.where(neg, 0, -1)).astype("int32")
+    # encoded regression targets vs the matched gt
+    mg = gt[arg_gt]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = jnp.maximum(mg[:, 2] - mg[:, 0], 1e-6)
+    gh = jnp.maximum(mg[:, 3] - mg[:, 1], 1e-6)
+    gcx = mg[:, 0] + 0.5 * gw
+    gcy = mg[:, 1] + 0.5 * gh
+    tgt = jnp.stack([(gcx - acx) / jnp.maximum(aw, 1e-6),
+                     (gcy - acy) / jnp.maximum(ah, 1e-6),
+                     jnp.log(gw / jnp.maximum(aw, 1e-6)),
+                     jnp.log(gh / jnp.maximum(ah, 1e-6))], axis=1)
+    tgt = jnp.where(pos[:, None], tgt, 0.0)
+    return {"Labels": [labels], "MatchedGt": [arg_gt],
+            "BboxTargets": [tgt]}

@@ -271,3 +271,55 @@ def test_multiclass_nms_pixel_convention():
         return int(num[0])
     assert run_t(True) == 2    # 0.375 below threshold: both kept
     assert run_t(False) == 1   # 0.444 above: suppressed
+
+
+def test_generate_proposals_and_rpn_target_assign():
+    rng = np.random.RandomState(7)
+    H = W = 4
+    A = 2
+    # anchors: [H, W, A, 4]
+    base = np.array([[-8, -8, 8, 8], [-12, -6, 12, 6]], "float32")
+    cy, cx = np.meshgrid(np.arange(H) * 8 + 4, np.arange(W) * 8 + 4,
+                         indexing="ij")
+    ctr = np.stack([cx, cy, cx, cy], -1).astype("float32")  # [H, W, 4]
+    anchors = ctr[:, :, None, :] + base[None, None]
+    variances = np.ones_like(anchors)
+
+    def build():
+        Aattr = dict(append_batch_size=False)
+        sc = fluid.data("sc", [1, A, H, W], "float32", **Aattr)
+        dl = fluid.data("dl", [1, 4 * A, H, W], "float32", **Aattr)
+        im = fluid.data("im", [1, 3], "float32", **Aattr)
+        an = fluid.layers.assign(anchors)
+        va = fluid.layers.assign(variances)
+        rois, probs, num = layers.generate_proposals(
+            sc, dl, im, an, va, pre_nms_top_n=16, post_nms_top_n=8,
+            nms_thresh=0.6, min_size=2.0)
+        gt = fluid.data("gt", [2, 4], "float32", **Aattr)
+        flat_anchors = fluid.layers.reshape(an, [-1, 4])
+        bbox_pred = fluid.data("bp", [H * W * A, 4], "float32", **Aattr)
+        cls_logits = fluid.data("cl", [H * W * A, 1], "float32", **Aattr)
+        sp, lp, st, lt, iw = layers.rpn_target_assign(
+            bbox_pred, cls_logits, flat_anchors, va, gt)
+        return [rois, probs, num, st, lt, iw]
+    feeds = {"sc": rng.rand(1, A, H, W).astype("float32"),
+             "dl": (rng.randn(1, 4 * A, H, W) * 0.05).astype("float32"),
+             "im": np.array([[32, 32, 1.0]], "float32"),
+             "gt": np.array([[0, 0, 12, 12], [20, 20, 30, 28]], "float32"),
+             "bp": np.zeros((H * W * A, 4), "float32"),
+             "cl": np.zeros((H * W * A, 1), "float32")}
+    rois, probs, num, st, lt, iw = _run(build, feeds)
+    n = int(num[0])
+    assert 1 <= n <= 8
+    # kept rois are clipped to the image and ordered by score
+    assert (rois[0, :n] >= 0).all() and (rois[0, :n] <= 31).all()
+    assert (np.diff(probs[0, :n, 0]) <= 1e-6).all()
+    # pairwise IoU below the NMS threshold
+    for i in range(n):
+        for j in range(i + 1, n):
+            assert _np_iou(rois[0, i:i + 1], rois[0, j:j + 1])[0, 0] <= 0.6 + 1e-5
+    # rpn targets: at least one positive per gt (force-best rule), and
+    # inside weights mark exactly the positives
+    assert (st == 1).sum() >= 2
+    assert ((iw[:, 0] == 1) == (st[:, 0] == 1)).all()
+    assert np.isfinite(lt).all()
