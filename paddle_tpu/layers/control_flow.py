@@ -728,20 +728,15 @@ not_equal = _cmp_layer("not_equal")
 
 
 def is_empty(x, cond=None):
-    """Reference control_flow.py:is_empty. Shapes are static under XLA, so
-    emptiness is a compile-time fact materialized as a constant; a dynamic
-    (-1) dim has no build-time answer and raises rather than guessing."""
-    if any(s == -1 for s in x.shape):
-        raise ValueError(
-            f"is_empty({x.name}): shape {x.shape} has a dynamic dim; "
-            f"emptiness is only decidable for static shapes under XLA -- "
-            f"guard with a host-side check on the feed instead")
-    empty = any(s == 0 for s in x.shape)
-    out = tensor.fill_constant([1], "bool", 1.0 if empty else 0.0)
-    if cond is not None:
-        tensor.assign(out, cond)
-        return cond
-    return out
+    """Reference control_flow.py:is_empty. Decided at LOWERING time, where
+    every dim (including the batch, concrete once the feed arrives) is
+    static -- so feed vars with a -1 build-time dim work, unlike a
+    build-time constant which would bake in the wrong answer."""
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference(
+        "bool", stop_gradient=True)
+    helper.append_op("is_empty", inputs={"X": [x]}, outputs={"Out": [out]})
+    return helper.main_program.current_block().var(out.name)
 
 
 def Print(input, first_n=-1, message=None, summarize=20,

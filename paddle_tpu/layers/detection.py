@@ -11,7 +11,7 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
            "multiclass_nms", "multiclass_nms2", "roi_align", "roi_pool",
            "anchor_generator", "box_clip", "bipartite_match",
            "target_assign", "ssd_loss", "sigmoid_focal_loss",
-           "detection_output", "density_prior_box", "generate_proposals", "rpn_target_assign"]
+           "detection_output", "density_prior_box", "generate_proposals", "rpn_target_assign", "yolov3_loss"]
 
 
 def _out(helper, dtype="float32", stop_gradient=False):
@@ -353,14 +353,20 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     labels = _out(helper, "int32", stop_gradient=True)
     matched = _out(helper, "int32", stop_gradient=True)
     tgt = _out(helper, anchor_box.dtype, stop_gradient=True)
-    helper.append_op("rpn_target_assign",
-                     inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+    inputs = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    helper.append_op("rpn_target_assign", inputs=inputs,
                      outputs={"Labels": [labels], "MatchedGt": [matched],
                               "BboxTargets": [tgt]},
                      attrs={"rpn_positive_overlap": float(
                                 rpn_positive_overlap),
                             "rpn_negative_overlap": float(
-                                rpn_negative_overlap)})
+                                rpn_negative_overlap),
+                            "rpn_straddle_thresh": float(
+                                rpn_straddle_thresh)})
     blk = helper.main_program.current_block()
     labels = blk.var(labels.name)
     tgt = blk.var(tgt.name)
@@ -382,3 +388,24 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
         _nn.scale(_nn.scale(valid, -1.0, bias=1.0), 0.5))
     inside_w = _nn.reshape(pos_mask, [-1, 1])
     return (score_pred, bbox_pred, score_tgt, tgt, inside_w)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None):
+    """Reference detection.py:yolov3_loss (one detection head). gt_box
+    [N, B, 4] normalized cxcywh, padded rows have zero area."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = _out(helper, x.dtype)
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    helper.append_op("yolov3_loss", inputs=inputs,
+                     outputs={"Loss": [loss]},
+                     attrs={"anchors": [int(a) for a in anchors],
+                            "anchor_mask": [int(m) for m in anchor_mask],
+                            "class_num": int(class_num),
+                            "ignore_thresh": float(ignore_thresh),
+                            "downsample_ratio": int(downsample_ratio),
+                            "use_label_smooth": bool(use_label_smooth)})
+    return helper.main_program.current_block().var(loss.name)

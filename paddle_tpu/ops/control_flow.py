@@ -182,6 +182,14 @@ def array_read_op(ctx, ins):
                                                  keepdims=False)]}
 
 
+@register("is_empty", grad=None)
+def is_empty_op(ctx, ins):
+    """numel == 0 is a static fact at lowering (controlflow/is_empty_op)."""
+    import jax.numpy as jnp
+    x = ins["X"][0]
+    return {"Out": [jnp.full((1,), x.size == 0, bool)]}
+
+
 @register("print", grad="auto")
 def print_op(ctx, ins):
     """Debug print (reference print_op.cc / lodtensor_printer): host callback."""

@@ -573,9 +573,21 @@ def rpn_target_assign(ctx, ins):
     jnp = _jnp()
     anchors = ins["Anchor"][0]
     gt = ins["GtBoxes"][0]
+    is_crowd = ins.get("IsCrowd", [None])[0]
+    im_info = ins.get("ImInfo", [None])[0]
     pos_ov = float(ctx.attr("rpn_positive_overlap", 0.7))
     neg_ov = float(ctx.attr("rpn_negative_overlap", 0.3))
-    iou = _iou_matrix(gt, anchors)                     # [G, M]
+    straddle = float(ctx.attr("rpn_straddle_thresh", 0.0))
+    iou_all = _iou_matrix(gt, anchors)                 # [G, M]
+    if is_crowd is not None:
+        # crowd gts never match as positives (rpn_target_assign_op.cc);
+        # anchors overlapping a crowd region get IGNORED below
+        crowd = is_crowd.reshape(-1, 1).astype(bool)
+        iou = jnp.where(crowd, 0.0, iou_all)
+        crowd_ov = jnp.max(jnp.where(crowd, iou_all, 0.0), axis=0)
+    else:
+        iou = iou_all
+        crowd_ov = jnp.zeros((anchors.shape[0],), jnp.float32)
     best_per_anchor = jnp.max(iou, axis=0)             # [M]
     arg_gt = jnp.argmax(iou, axis=0).astype("int32")
     # force-positive: the best anchor for every gt
@@ -585,6 +597,17 @@ def rpn_target_assign(ctx, ins):
     pos = (best_per_anchor >= pos_ov) | is_best_for_some_gt
     neg = (best_per_anchor < neg_ov) & ~pos
     labels = jnp.where(pos, 1, jnp.where(neg, 0, -1)).astype("int32")
+    # anchors over crowd regions are ignored rather than negative
+    labels = jnp.where((crowd_ov >= neg_ov) & ~pos, -1, labels)
+    if im_info is not None and straddle >= 0:
+        # straddling anchors (outside image + thresh) are ignored
+        # (rpn_straddle_thresh, reference default 0)
+        h, w = im_info[0, 0], im_info[0, 1]
+        inside = ((anchors[:, 0] >= -straddle) &
+                  (anchors[:, 1] >= -straddle) &
+                  (anchors[:, 2] < w + straddle) &
+                  (anchors[:, 3] < h + straddle))
+        labels = jnp.where(inside, labels, -1)
     # encoded regression targets vs the matched gt
     mg = gt[arg_gt]
     aw = anchors[:, 2] - anchors[:, 0]
@@ -602,3 +625,135 @@ def rpn_target_assign(ctx, ins):
     tgt = jnp.where(pos[:, None], tgt, 0.0)
     return {"Labels": [labels], "MatchedGt": [arg_gt],
             "BboxTargets": [tgt]}
+
+
+@register("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel", "GTScore"))
+def yolov3_loss(ctx, ins):
+    """YOLOv3 training loss (detection/yolov3_loss_op.h), one detection head.
+
+    X [N, A*(5+C), H, W]; GTBox [N, B, 4] normalized (cx, cy, w, h);
+    GTLabel [N, B] int (padded rows have w*h == 0 and are masked out).
+    attrs: anchors (full list, x/y pairs), anchor_mask (indices of this
+    head's anchors), class_num, ignore_thresh, downsample_ratio,
+    use_label_smooth.
+
+    Responsibility: each gt is owned by the best-IoU anchor (shape-only IoU
+    over ALL anchors, reference rule); if that anchor is in this head's
+    mask, the gt's grid cell learns x/y/w/h (w/h loss scaled by
+    2 - w*h, the reference's size balancing), objectness 1, and one-hot
+    class targets. Other predictions learn objectness 0 EXCEPT those whose
+    decoded box overlaps any gt above ignore_thresh (no gradient). All
+    fixed-shape: gts scatter into the [A, H, W] target grids.
+    """
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]
+    gtbox = ins["GTBox"][0].astype(jnp.float32)
+    gtlabel = ins["GTLabel"][0].astype("int32")
+    anchors = [float(a) for a in ctx.attr("anchors", [])]
+    mask = [int(m) for m in ctx.attr("anchor_mask", [])]
+    C = int(ctx.attr("class_num"))
+    ignore = float(ctx.attr("ignore_thresh", 0.7))
+    down = int(ctx.attr("downsample_ratio", 32))
+    N, _, H, W = x.shape
+    A = len(mask)
+    B = gtbox.shape[1]
+    x = x.reshape(N, A, 5 + C, H, W)
+    in_w, in_h = W * down, H * down
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32)
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32)
+
+    sig = jax.nn.sigmoid
+
+    gscore_all = ins.get("GTScore", [None])[0]
+    if gscore_all is None:
+        gscore_all = jnp.ones((N, B), jnp.float32)
+    else:
+        gscore_all = gscore_all.astype(jnp.float32)
+
+    def per_image(xi, gb, gl, gsc):
+        valid = (gb[:, 2] * gb[:, 3] > 0)                     # [B]
+        # best anchor per gt: shape-only IoU in input pixels
+        gw = gb[:, 2] * in_w
+        gh = gb[:, 3] * in_h
+        inter = (jnp.minimum(gw[:, None], all_aw[None, :]) *
+                 jnp.minimum(gh[:, None], all_ah[None, :]))
+        union = gw[:, None] * gh[:, None] + \
+            (all_aw * all_ah)[None, :] - inter
+        best_anchor = jnp.argmax(inter / union, axis=1)       # [B]
+        # position in this head's grid
+        gi = jnp.clip((gb[:, 0] * W).astype("int32"), 0, W - 1)
+        gj = jnp.clip((gb[:, 1] * H).astype("int32"), 0, H - 1)
+        # which of this head's anchor slots owns each gt (-1 if none)
+        slot = jnp.full((B,), -1, "int32")
+        for k, m in enumerate(mask):
+            slot = jnp.where(best_anchor == m, k, slot)
+        own = valid & (slot >= 0)
+        s = jnp.maximum(slot, 0)
+
+        # Scatter per-gt targets into [A, H, W] grids. Non-own rows must
+        # contribute NOTHING -- .at[].set with duplicate indices is
+        # nondeterministic and a padded row forced to slot 0 could clobber
+        # a real gt's cell (review repro). Masked .add on a zero grid is
+        # order-independent; two gts in one cell+slot (inherently ambiguous,
+        # reference keeps one arbitrarily) sum, with objectness clipped.
+        def grid(vals):
+            g = jnp.zeros((A, H, W), jnp.float32)
+            return g.at[s, gj, gi].add(jnp.where(own, vals, 0.0))
+
+        obj_raw = grid(jnp.ones((B,)))
+        obj_tgt = jnp.minimum(obj_raw, 1.0)
+        dedup = jnp.where(obj_raw > 0, obj_raw, 1.0)   # average collisions
+        tx = grid(gb[:, 0] * W - gi) / dedup
+        ty = grid(gb[:, 1] * H - gj) / dedup
+        aw_s = jnp.asarray([anchors[2 * m] for m in mask], jnp.float32)
+        ah_s = jnp.asarray([anchors[2 * m + 1] for m in mask], jnp.float32)
+        tw = grid(jnp.log(jnp.maximum(gw, 1e-6) /
+                          jnp.maximum(aw_s[s], 1e-6))) / dedup
+        th = grid(jnp.log(jnp.maximum(gh, 1e-6) /
+                          jnp.maximum(ah_s[s], 1e-6))) / dedup
+        scale = grid(2.0 - gb[:, 2] * gb[:, 3]) / dedup       # size balance
+        smooth = bool(ctx.attr("use_label_smooth", False))
+        pos_v = 1.0 - 1.0 / C if smooth else 1.0
+        neg_v = 1.0 / C if smooth else 0.0
+        cls_tgt = jnp.full((A, H, W, C), neg_v, jnp.float32).at[
+            s, gj, gi, jnp.clip(gl, 0, C - 1)].add(
+            jnp.where(own, pos_v - neg_v, 0.0))
+        cls_tgt = jnp.minimum(cls_tgt, pos_v)
+        # mixup: objectness target carries the gt confidence
+        obj_score = jnp.minimum(grid(gsc), 1.0)
+        obj_tgt_val = jnp.where(obj_tgt > 0, obj_score, 0.0)
+
+        # decode predictions for the ignore rule
+        px = (jnp.arange(W)[None, None, :] + sig(xi[:, 0])) / W
+        py = (jnp.arange(H)[None, :, None] + sig(xi[:, 1])) / H
+        pw = jnp.exp(jnp.minimum(xi[:, 2], 10.0)) * \
+            aw_s.reshape(A, 1, 1) / in_w
+        ph = jnp.exp(jnp.minimum(xi[:, 3], 10.0)) * \
+            ah_s.reshape(A, 1, 1) / in_h
+        pred = jnp.stack([px - pw / 2, py - ph / 2,
+                          px + pw / 2, py + ph / 2], -1).reshape(-1, 4)
+        gxy = jnp.stack([gb[:, 0] - gb[:, 2] / 2, gb[:, 1] - gb[:, 3] / 2,
+                         gb[:, 0] + gb[:, 2] / 2, gb[:, 1] + gb[:, 3] / 2],
+                        axis=1)
+        iou_pg = _iou_matrix(pred, gxy)                       # [AHW, B]
+        iou_pg = jnp.where(valid[None, :], iou_pg, 0.0)
+        ignore_mask = (jnp.max(iou_pg, axis=1) > ignore).reshape(A, H, W)
+
+        def bce(logit, tgt):
+            return jnp.maximum(logit, 0) - logit * tgt + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        loss_xy = scale * (bce(xi[:, 0], tx) + bce(xi[:, 1], ty)) * obj_tgt
+        loss_wh = scale * ((xi[:, 2] - tw) ** 2 +
+                           (xi[:, 3] - th) ** 2) * 0.5 * obj_tgt
+        obj_loss = bce(xi[:, 4], obj_tgt_val)
+        loss_obj = jnp.where(obj_tgt > 0, obj_loss,
+                             jnp.where(ignore_mask, 0.0, obj_loss))
+        loss_cls = jnp.sum(
+            bce(xi[:, 5:].transpose(0, 2, 3, 1), cls_tgt), -1) * obj_tgt
+        return (jnp.sum(loss_xy) + jnp.sum(loss_wh) + jnp.sum(loss_obj) +
+                jnp.sum(loss_cls))
+
+    loss = jax.vmap(per_image)(x, gtbox, gtlabel, gscore_all)
+    return {"Loss": [loss[:, None].astype(ins["X"][0].dtype)]}

@@ -323,3 +323,39 @@ def test_generate_proposals_and_rpn_target_assign():
     assert (st == 1).sum() >= 2
     assert ((iw[:, 0] == 1) == (st[:, 0] == 1)).all()
     assert np.isfinite(lt).all()
+
+
+def test_yolov3_loss_trains_toward_gt():
+    """A head trained with yolov3_loss must (a) drop its loss and (b) decode
+    (via yolo_box) boxes near the ground truth afterwards."""
+    N, A, C, H = 1, 3, 4, 4
+    anchors = [10, 14, 23, 27, 37, 58]
+    gt_box = np.array([[[0.4, 0.4, 0.3, 0.35],
+                        [0.0, 0.0, 0.0, 0.0]]], "float32")   # 1 real + pad
+    gt_label = np.array([[2, 0]], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        Aattr = dict(append_batch_size=False)
+        feat = fluid.data("feat", [N, 8, H, H], "float32", **Aattr)
+        gb = fluid.data("gb", [N, 2, 4], "float32", **Aattr)
+        gl = fluid.data("gl", [N, 2], "int64", **Aattr)
+        head = fluid.layers.conv2d(feat, A * (5 + C), 1)
+        loss = fluid.layers.reduce_mean(layers.yolov3_loss(
+            head, gb, gl, anchors, [0, 1, 2], C, ignore_thresh=0.7,
+            downsample_ratio=8))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"feat": rng.randn(N, 8, H, H).astype("float32"),
+            "gb": gt_box, "gl": gt_label}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])

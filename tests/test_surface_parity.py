@@ -58,11 +58,14 @@ def test_cmp_layers_and_is_empty_and_print():
         le = layers.less_equal(a, b)
         ne = layers.not_equal(a, b)
         emp = layers.is_empty(a)
+        feedvar = fluid.data("ie_x", [3], "float32")     # [-1, 3]: must build
+        emp2 = layers.is_empty(feedvar)
         p = layers.Print(a, message="dbg: ")
-        return [gt, ge, le, ne, emp, p]
-    gt, ge, le, ne, emp, p = _run(build, {})
+        return [gt, ge, le, ne, emp, emp2, p]
+    gt, ge, le, ne, emp, emp2, p = _run(
+        build, {"ie_x": np.zeros((2, 3), "float32")})
     assert gt.all() and ge.all() and le.all() and ne.all()
-    assert not emp[0]
+    assert not emp[0] and not emp2[0]
     assert layers.StaticRNN is layers.Scan
 
 
