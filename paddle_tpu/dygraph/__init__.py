@@ -8,3 +8,4 @@ from .nn import (Layer, Linear, FC, Conv2D, Pool2D, Embedding, BatchNorm,  # noq
 from .optimizer import SGDOptimizer, AdamOptimizer, MomentumOptimizer  # noqa
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
 from .parallel import DataParallel, ParallelStrategy, prepare_context  # noqa
+from .jit import TracedLayer  # noqa: F401

@@ -26,6 +26,7 @@ class _State(threading.local):
         self.enabled = False
         self.tape: List[dict] = []
         self.taping = True
+        self.trace_all = False   # TracedLayer: record even non-diff ops
         self.op_counter = 0
         self.seed = 0
 
@@ -168,9 +169,13 @@ def trace_op(op_type: str, ins: Dict[str, List[VarBase]], attrs: dict,
         vals = outs.get(s, [])
         out_vars[s] = [VarBase(v, stop_gradient=stop_all or d.grad is None)
                        if v is not None else None for v in vals]
-    if _state.taping and not stop_all and d.grad is not None:
+    normal = _state.taping and not stop_all and d.grad is not None
+    if normal or _state.trace_all:
         _state.tape.append({"type": op_type, "attrs": dict(attrs),
                             "salt": ctx._salt,
+                            # recorded ONLY for TracedLayer, not autograd:
+                            # trace() strips these afterwards
+                            "_trace_only": not normal,
                             "ins": {s: list(vs) for s, vs in ins.items()},
                             "outs": {s: list(vs)
                                      for s, vs in out_vars.items()}})

@@ -218,3 +218,57 @@ def test_dygraph_data_parallel_actually_shards():
         # batch dim partitioned over all 8 devices
         assert len(shards.device_set) == 8
         assert out.value.addressable_shards[0].data.shape[0] == 2
+
+
+def test_traced_layer_matches_dygraph_and_serves(tmp_path):
+    """TracedLayer: dygraph -> static Program capture; outputs match the
+    eager run, the traced program re-runs on new data, and the export
+    serves through inference.Predictor (reference dygraph/jit.py)."""
+    rng = np.random.RandomState(4)
+
+    class Net(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c = dygraph.Conv2D(1, 4, 3, padding=1, act="relu")
+            self.fc = dygraph.Linear(4 * 8 * 8, 10)
+
+        def forward(self, x):
+            h = self.c(x)
+            h = dygraph.trace_op("reshape", {"X": [h]},
+                                 {"shape": [0, 4 * 8 * 8]}, ["Out"])["Out"][0]
+            return self.fc(h)
+
+    x1 = rng.randn(2, 1, 8, 8).astype("float32")
+    x2 = rng.randn(5, 1, 8, 8).astype("float32")
+    with dygraph.guard():
+        net = Net()
+        eager_out, traced = dygraph.TracedLayer.trace(
+            net, [dygraph.to_variable(x1)])
+        eager2 = net(dygraph.to_variable(x2)).numpy()
+        eager1 = eager_out.numpy()
+
+    got1, = traced([x1])
+    np.testing.assert_allclose(got1, eager1, rtol=1e-5, atol=1e-6)
+    got2, = traced([x2])                 # new batch size through -1 feed dim
+    np.testing.assert_allclose(got2, eager2, rtol=1e-5, atol=1e-6)
+
+    d = str(tmp_path / "traced")
+    traced.save_inference_model(d)
+    pred = fluid.inference.Predictor(d)
+    out, = pred.run([x2])
+    np.testing.assert_allclose(out, eager2, rtol=1e-5, atol=1e-6)
+
+
+def test_traced_layer_keeps_autograd_alive():
+    """Training through the outputs of TracedLayer.trace must still produce
+    gradients (only trace-only tape entries are stripped)."""
+    rng = np.random.RandomState(5)
+    with dygraph.guard():
+        lin = dygraph.Linear(4, 2)
+        x = dygraph.to_variable(rng.randn(3, 4).astype("float32"))
+        out, traced = dygraph.TracedLayer.trace(lin, [x])
+        loss = dygraph.trace_op("mean", {"X": [out * out]}, {},
+                                ["Out"])["Out"][0]
+        loss.backward()
+        assert lin.weight.gradient() is not None
+        assert np.abs(lin.weight.gradient()).sum() > 0
