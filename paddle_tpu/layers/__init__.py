@@ -5,7 +5,8 @@ from .tensor import (create_tensor, create_global_var, create_parameter,  # noqa
                      concat, sums, argmax, argmin, argsort, ones, zeros,
                      ones_like, zeros_like, linspace, diag, eye, isfinite,
                      has_nan, has_inf, reverse, tensor_array_to_tensor)
-from .tensor import range as range_  # noqa: F401  (avoid shadowing builtin at import *)
+from .tensor import range as range_  # noqa: F401  (import-* safe alias)
+from .tensor import range  # noqa: F401  (reference exports `range` itself)
 from .io import (data, double_buffer, py_reader,  # noqa: F401
                  create_py_reader_by_data, load, read_file)
 from . import learning_rate_scheduler  # noqa: F401

@@ -11,7 +11,9 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
            "multiclass_nms", "multiclass_nms2", "roi_align", "roi_pool",
            "anchor_generator", "box_clip", "bipartite_match",
            "target_assign", "ssd_loss", "sigmoid_focal_loss",
-           "detection_output", "density_prior_box", "generate_proposals", "rpn_target_assign", "yolov3_loss"]
+           "detection_output", "density_prior_box", "generate_proposals", "rpn_target_assign", "yolov3_loss",
+           "box_decoder_and_assign", "polygon_box_transform",
+           "retinanet_detection_output", "multi_box_head"]
 
 
 def _out(helper, dtype="float32", stop_gradient=False):
@@ -409,3 +411,125 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                             "downsample_ratio": int(downsample_ratio),
                             "use_label_smooth": bool(use_label_smooth)})
     return helper.main_program.current_block().var(loss.name)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip_value=4.135, name=None):
+    """Reference detection.py:box_decoder_and_assign: decode per-class box
+    deltas, then pick each prior's best-scoring class box.
+    target_box [M, 4*C]; box_score [M, C]. Returns (decoded_box [M, 4*C],
+    output_assign_box [M, 4])."""
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decoded = _out(helper, target_box.dtype)
+    assigned = _out(helper, target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box],
+              "BoxScore": [box_score]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_decoder_and_assign", inputs=inputs,
+                     outputs={"DecodeBox": [decoded],
+                              "OutputAssignBox": [assigned]},
+                     attrs={"box_clip": float(box_clip_value)})
+    blk = helper.main_program.current_block()
+    return blk.var(decoded.name), blk.var(assigned.name)
+
+
+def polygon_box_transform(input, name=None):
+    """Reference detection.py:polygon_box_transform (EAST text detection):
+    quad offset maps -> absolute vertex coordinates."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op("polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return helper.main_program.current_block().var(out.name)
+
+
+def retinanet_detection_output(bboxes, scores, im_info, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.3, nms_eta=1.0):
+    """Reference detection.py:retinanet_detection_output: per-level decoded
+    boxes/scores (already sigmoid) concat -> NMS. bboxes/scores: lists of
+    [N, Mi, 4] / [N, Mi, C] per FPN level."""
+    from . import nn as _nn
+    from .tensor import concat as _concat
+    boxes = _concat(list(bboxes), axis=1) if isinstance(
+        bboxes, (list, tuple)) else bboxes
+    scs = _concat(list(scores), axis=1) if isinstance(
+        scores, (list, tuple)) else scores
+    boxes = box_clip(boxes, im_info)                 # reference clips to image
+    scs = _nn.transpose(scs, [0, 2, 1])              # [N, C, M]
+    # deviation: the reference pre-selects nms_top_k PER FPN level before the
+    # global NMS; here the top-k is global over the concatenated levels
+    # (fixed-shape friendly; revisit if a level-starvation case shows up)
+    out, num = multiclass_nms(boxes, scs, score_threshold, nms_top_k,
+                              keep_top_k, nms_threshold, True, nms_eta,
+                              background_label=-1)
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """Reference detection.py:multi_box_head (the SSD head): per feature map,
+    prior boxes + conv loc/conf predictions, flattened and concatenated.
+    Returns (mbox_locs [N, M, 4], mbox_confs [N, M, C], boxes [M, 4],
+    variances [M, 4])."""
+    from . import nn as _nn
+    n_maps = len(inputs)
+    if min_sizes is None:
+        if n_maps <= 2:
+            raise ValueError(
+                "multi_box_head: the min_ratio/max_ratio schedule needs at "
+                "least 3 feature maps (reference detection.py contract); "
+                "pass explicit min_sizes/max_sizes for fewer maps")
+        # reference ratio schedule between min_ratio and max_ratio (%)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_maps - 2))
+        for r in range(min_ratio, max_ratio + 1, step or 1):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_maps - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_maps - 1]
+    locs, confs, priors, vars_ = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0],
+                                            (list, tuple)) else aspect_ratios
+        if steps:
+            st = steps[i]
+        elif step_w or step_h:
+            st = ((step_w[i] if step_w else 0.0),
+                  (step_h[i] if step_h else 0.0))
+        else:
+            st = (0.0, 0.0)
+        box, var = prior_box(feat, image,
+                             mins if isinstance(mins, (list, tuple))
+                             else [mins],
+                             [maxs] if maxs else None, ar, variance, flip,
+                             clip, st if isinstance(st, (list, tuple))
+                             else (st, st), offset)
+        box = _nn.reshape(box, [-1, 4])
+        var = _nn.reshape(var, [-1, 4])
+        A = int(box.shape[0]) // (int(feat.shape[2]) * int(feat.shape[3]))
+        loc = _nn.conv2d(feat, A * 4, kernel_size, padding=pad, stride=stride)
+        conf = _nn.conv2d(feat, A * num_classes, kernel_size, padding=pad,
+                          stride=stride)
+        # [N, A*4, H, W] -> [N, H*W*A, 4]
+        loc = _nn.reshape(_nn.transpose(loc, [0, 2, 3, 1]), [0, -1, 4])
+        conf = _nn.reshape(_nn.transpose(conf, [0, 2, 3, 1]),
+                           [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        priors.append(box)
+        vars_.append(var)
+    from .tensor import concat as _concat
+    mbox_locs = _concat(locs, axis=1)
+    mbox_confs = _concat(confs, axis=1)
+    boxes = _concat(priors, axis=0)
+    variances = _concat(vars_, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+

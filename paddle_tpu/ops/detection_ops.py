@@ -757,3 +757,64 @@ def yolov3_loss(ctx, ins):
 
     loss = jax.vmap(per_image)(x, gtbox, gtlabel, gscore_all)
     return {"Loss": [loss[:, None].astype(ins["X"][0].dtype)]}
+
+
+@register("box_decoder_and_assign", grad=None,
+          nondiff_inputs=("PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"))
+def box_decoder_and_assign(ctx, ins):
+    """detection/box_decoder_and_assign_op.cc: decode per-class deltas
+    [M, 4*C] against the priors, clip the log-space sizes, and per prior
+    pick the box of its argmax-scoring class."""
+    jnp = _jnp()
+    prior = ins["PriorBox"][0]                  # [M, 4]
+    deltas = ins["TargetBox"][0]                # [M, 4*C]
+    score = ins["BoxScore"][0]                  # [M, C]
+    pv = ins.get("PriorBoxVar", [None])[0]
+    clip = float(ctx.attr("box_clip", 4.135))
+    M = prior.shape[0]
+    C = score.shape[-1]
+    d = deltas.reshape(M, C, 4)
+    if pv is not None:
+        d = d * pv[:, None, :]
+    pw = (prior[:, 2] - prior[:, 0])[:, None]
+    ph = (prior[:, 3] - prior[:, 1])[:, None]
+    pcx = (prior[:, 0])[:, None] + 0.5 * pw
+    pcy = (prior[:, 1])[:, None] + 0.5 * ph
+    cx = pcx + d[..., 0] * pw
+    cy = pcy + d[..., 1] * ph
+    w = jnp.exp(jnp.minimum(d[..., 2], clip)) * pw
+    h = jnp.exp(jnp.minimum(d[..., 3], clip)) * ph
+    # reference pixel convention: max coords get a -1
+    boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                       cx + w / 2 - 1, cy + h / 2 - 1],
+                      axis=-1)                  # [M, C, 4]
+    # reference AssignBoxProp skips class 0 (background); if the best
+    # foreground score does not exist the prior itself is assigned
+    fg_score = score.at[:, 0].set(-jnp.inf) if C > 1 else score
+    best = jnp.argmax(fg_score, axis=-1)
+    assigned = jnp.take_along_axis(
+        boxes, best[:, None, None].astype("int32").repeat(4, -1), axis=1)[:, 0]
+    if C > 1:
+        assigned = jnp.where((best > 0)[:, None], assigned, prior)
+    return {"DecodeBox": [boxes.reshape(M, 4 * C)],
+            "OutputAssignBox": [assigned]}
+
+
+@register("polygon_box_transform", grad=None)
+def polygon_box_transform(ctx, ins):
+    """detection/polygon_box_transform_op.cc (EAST): input [N, 2K, H, W]
+    holds per-pixel (x, y) offsets for K quad vertices; the output adds the
+    pixel's own coordinate to each offset wherever the offset map is active
+    (reference: out = offset == 0 ? 0 : pixel_coord - offset)."""
+    jnp = _jnp()
+    x = ins["Input"][0]
+    N, C2, H, W = x.shape
+    # EAST geo maps are quarter-resolution: coordinate = map index * 4
+    # (polygon_box_transform_op.cc:44 `id_w * 4 - in`)
+    gx = 4.0 * jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    gy = 4.0 * jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    coord = jnp.where((jnp.arange(C2) % 2 == 0)[None, :, None, None],
+                      jnp.broadcast_to(gx, x.shape),
+                      jnp.broadcast_to(gy, x.shape))
+    out = coord - x
+    return {"Output": [out]}

@@ -359,3 +359,60 @@ def test_yolov3_loss_trains_toward_gt():
             losses.append(float(np.asarray(lv).reshape(())))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_detection_tail_layers():
+    rng = np.random.RandomState(9)
+    M, C = 6, 3
+    prior = np.sort(rng.rand(M, 2) * 40, 0)
+    prior = np.concatenate([prior, prior + 8], 1).astype("float32")
+
+    def build():
+        A = dict(append_batch_size=False)
+        pb = fluid.layers.assign(prior)
+        deltas = fluid.data("dl", [M, 4 * C], "float32", **A)
+        score = fluid.data("sc", [M, C], "float32", **A)
+        dec, assigned = layers.box_decoder_and_assign(pb, None, deltas, score)
+        quad = fluid.data("q", [1, 8, 4, 4], "float32", **A)
+        poly = layers.polygon_box_transform(quad)
+        return [dec, assigned, poly]
+    feeds = {"dl": (rng.randn(M, 4 * C) * 0.1).astype("float32"),
+             "sc": rng.rand(M, C).astype("float32"),
+             "q": rng.randn(1, 8, 4, 4).astype("float32")}
+    dec, assigned, poly = _run(build, feeds)
+    assert dec.shape == (M, 4 * C) and assigned.shape == (M, 4)
+    # assigned = decoded box of the argmax FOREGROUND class (bg col 0
+    # skipped, reference AssignBoxProp)
+    best = feeds["sc"][:, 1:].argmax(1) + 1
+    for m in range(M):
+        np.testing.assert_allclose(assigned[m],
+                                   dec[m].reshape(C, 4)[best[m]], rtol=1e-6)
+    # polygon (EAST): quarter-res maps -> coord = 4*index - offset
+    assert poly.shape == (1, 8, 4, 4)
+    q = feeds["q"]
+    want_x = 4 * np.arange(4)[None, None, :] - q[:, 0]
+    np.testing.assert_allclose(want_x, poly[:, 0], rtol=1e-5)
+
+
+def test_multi_box_head_shapes():
+    def build():
+        A = dict(append_batch_size=False)
+        f1 = fluid.data("f1", [2, 8, 8, 8], "float32", **A)
+        f2 = fluid.data("f2", [2, 8, 4, 4], "float32", **A)
+        img = fluid.data("img", [2, 3, 64, 64], "float32", **A)
+        locs, confs, boxes, variances = layers.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=5,
+            aspect_ratios=[[1.0], [1.0, 2.0]],
+            min_sizes=[16.0, 32.0], max_sizes=[None, None],
+            flip=False)
+        return [locs, confs, boxes, variances]
+    rng = np.random.RandomState(10)
+    locs, confs, boxes, variances = _run(build, {
+        "f1": rng.randn(2, 8, 8, 8).astype("float32"),
+        "f2": rng.randn(2, 8, 4, 4).astype("float32"),
+        "img": rng.randn(2, 3, 64, 64).astype("float32")})
+    M = boxes.shape[0]
+    assert locs.shape == (2, M, 4)
+    assert confs.shape == (2, M, 5)
+    assert variances.shape == (M, 4)
+    assert (boxes[:, 2] > boxes[:, 0]).all()
