@@ -151,3 +151,14 @@ Bilinear = BilinearInitializer
 
 def force_init_on_cpu():
     return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """Reference initializer.py:init_on_cpu forced init ops onto the CPU to
+    save GPU memory during startup. Under PJRT the startup program is one
+    jitted step whose placement XLA owns -- no-op kept for ported code."""
+    yield

@@ -898,3 +898,13 @@ Ftrl = FtrlOptimizer
 LarsMomentum = LarsMomentumOptimizer
 Lamb = LambOptimizer
 Dpsgd = DpsgdOptimizer
+
+
+class DGCMomentumOptimizer:
+    """Reference optimizer.py:870. Not built -- deep gradient compression
+    trades MXU cycles for interconnect bandwidth TPUs are not short of; see
+    SCOPE.md (DGC row). Use Momentum, with BuildStrategy.ReduceStrategy.
+    Reduce for ZeRO-style state sharding when memory is the constraint."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(self.__doc__)

@@ -107,3 +107,14 @@ def profiler(state: str = "All", sorted_key: str = "total",
 
 def reset_profiler():
     _agg.times.clear()
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Reference profiler.py:cuda_profiler (nvprof hooks). There is no CUDA
+    here; the xplane trace (profiler()/start_profiler) covers the TPU. Kept
+    as a no-op context so ported scripts run."""
+    yield
