@@ -19,6 +19,9 @@ from . import layers  # noqa: F401
 from . import nets  # noqa: F401
 from . import dataset  # noqa: F401
 from . import fleet  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa
+from .transpiler import memory_optimize, release_memory  # noqa: F401
 from . import inference  # noqa: F401
 from .dataset_factory import (DatasetFactory, InMemoryDataset,  # noqa
                               QueueDataset)

@@ -1,0 +1,99 @@
+"""Transpiler facade (reference: python/paddle/fluid/transpiler/).
+
+The reference's transpilers rewrite programs for multi-process training
+(DistributeTranspiler: pserver/NCCL graph split, 2.6k LoC) and memory reuse
+(memory_optimize). Neither rewrite exists on this stack by design:
+
+* collective training = a DistributedStrategy over a mesh (GSPMD inserts the
+  collectives) -- the `fleet` facade is the high-level door;
+* pserver mode is scoped out (SCOPE.md parameter-server row);
+* memory optimization is XLA buffer reuse + donation (SCOPE.md).
+
+This module keeps the import surface alive for ported code: the memory fns
+are documented no-ops; DistributeTranspiler raises with the migration path.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "memory_optimize", "release_memory", "HashName", "RoundRobin"]
+
+
+class DistributeTranspilerConfig:
+    """Knob shell (reference distribute_transpiler.py:DistributeTranspilerConfig)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = True
+        self.mode = "pserver"
+
+
+class DistributeTranspiler:
+    """Reference distribute_transpiler.py:230. The graph rewrites it performed
+    are replaced wholesale: use ``fleet.distributed_optimizer`` (collective)
+    or ``CompiledProgram.with_strategy`` directly; pserver mode is scoped out
+    (SCOPE.md)."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None, current_endpoint=""):
+        raise NotImplementedError(
+            "DistributeTranspiler's pserver/NCCL program rewrite does not "
+            "exist on TPU: collective training is a sharding strategy "
+            "(fleet.distributed_optimizer(...).minimize(loss); run "
+            "fleet.main_program), and parameter-server mode is scoped out "
+            "-- see SCOPE.md")
+
+    def get_trainer_program(self, wait_port=True):
+        raise NotImplementedError("see transpile()")
+
+    def get_pserver_program(self, endpoint):
+        raise NotImplementedError("see transpile()")
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        raise NotImplementedError("see transpile()")
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Reference memory_optimization_transpiler.py. Buffer reuse is XLA's job
+    (donation + liveness analysis in the compiler); no-op with a one-time
+    note so ported pipelines keep running."""
+    warnings.warn("paddle_tpu: memory_optimize is a no-op -- XLA owns buffer "
+                  "reuse (donate_argnums + its own liveness passes)",
+                  UserWarning, stacklevel=2)
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """See memory_optimize: XLA frees buffers by liveness; no-op."""
+    return None
+
+
+class HashName:
+    """PS shard dispatcher shell (ps_dispatcher.py). Only meaningful with the
+    scoped-out pserver mode; kept so imports resolve."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        return [self._eps[hash(v.name) % len(self._eps)] for v in varlist]
+
+
+class RoundRobin:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self._eps[self._i % len(self._eps)])
+            self._i += 1
+        return out

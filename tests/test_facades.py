@@ -158,3 +158,22 @@ def test_native_parser_matches_python(tmp_path):
         np.testing.assert_allclose(cols[0], data[:, :8], rtol=1e-5, atol=1e-5)
     else:
         pytest.skip("no g++ toolchain; python fallback covered above")
+
+
+def test_transpiler_facade():
+    import warnings
+    t = fluid.DistributeTranspiler()
+    with pytest.raises(NotImplementedError, match="SCOPE"):
+        t.transpile(0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert fluid.memory_optimize(fluid.Program()) is None
+        assert any("no-op" in str(x.message) for x in w)
+    assert fluid.release_memory(fluid.Program()) is None
+    from paddle_tpu.transpiler import RoundRobin
+
+    class V:
+        def __init__(self, n):
+            self.name = n
+    rr = RoundRobin(["a", "b"])
+    assert rr.dispatch([V("x"), V("y"), V("z")]) == ["a", "b", "a"]
