@@ -18,6 +18,7 @@ from .detection import *     # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .rnn import *           # noqa: F401,F403
 from .extras import (maxout, lrn, pixel_shuffle, shuffle_channel,  # noqa
+                     host_embedding,
                      space_to_depth, temporal_shift, unfold, affine_channel,
                      bilinear_tensor_product, add_position_encoding,
                      multiplex, crop, crop_tensor, pad_constant_like,

@@ -1005,3 +1005,38 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
                      attrs={"soft_max_up_bound": soft_max_up_bound,
                             "soft_max_lower_bound": soft_max_lower_bound})
     return _var(helper, out)
+
+
+def host_embedding(input, size, name, optimizer="adagrad", learning_rate=0.05,
+                   dtype="float32", initializer=None, mmap_dir=None,
+                   async_updates=False, seed=0):
+    """Embedding lookup against a host-RAM (or memmapped) table -- the
+    beyond-HBM sparse path (reference: distributed lookup table,
+    transpiler/distribute_transpiler.py:1594, distributed_lookup_table_op).
+
+    Unlike ``embedding``, the table is NOT a Program parameter: it lives on
+    the host and is updated server-side on gradient push with its own
+    ``optimizer`` ('sgd'|'adagrad') at ``learning_rate``. The Program only
+    carries a [1]-float anchor parameter that anchors the push op into the
+    backward pass. See ops/host_table.py for the design.
+
+    ``name`` is required and process-global: it keys the table for
+    checkpointing (host_table.save_all) and re-use across programs.
+    """
+    from ..ops import host_table as ht
+    from ..initializer import Constant
+
+    ht.create_table(name, size[0], size[1], optimizer=optimizer,
+                    lr=learning_rate, initializer=initializer,
+                    mmap_dir=mmap_dir, async_updates=async_updates, seed=seed)
+    helper = LayerHelper("host_embedding", name=name + ".anchor")
+    from ..layer_helper import ParamAttr
+    anchor = helper.create_parameter(
+        ParamAttr(name=name + ".anchor", initializer=Constant(0.0)),
+        [1], "float32")
+    out = _out(helper, dtype)
+    helper.append_op("host_lookup_table",
+                     inputs={"Ids": [input], "Anchor": [anchor]},
+                     outputs={"Out": [out]},
+                     attrs={"table_name": name, "dtype": dtype})
+    return _var(helper, out)

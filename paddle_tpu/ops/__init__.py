@@ -25,3 +25,4 @@ from . import pallas_attention  # noqa: F401
 from . import extra_ops      # noqa: F401
 from . import ctc_crf_ops    # noqa: F401
 from . import sampled_ops    # noqa: F401
+from . import host_table     # noqa: F401

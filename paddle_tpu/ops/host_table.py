@@ -1,0 +1,323 @@
+"""Host-resident embedding tables: the parameter-server analog for beyond-HBM
+sparse models.
+
+Reference analog: the pserver distributed lookup table
+(`python/paddle/fluid/transpiler/distribute_transpiler.py:1594`
+`_replace_lookup_table_op_with_prefetch`, `operators/distributed_ops/
+distributed_lookup_table_op.cc`) and the Hogwild/Downpour CPU workers
+(`framework/device_worker.h:151,180`, `framework/fleet/fleet_wrapper.h:55`):
+tables too large for accelerator memory live on parameter servers; workers
+pull rows for the minibatch and push sparse gradients, and the *server*
+applies the optimizer update.
+
+TPU-native design (not a port): there is no RPC fleet. The table lives in
+host RAM (optionally a disk-backed ``np.memmap`` for tables beyond RAM) on
+the single controller process. The jitted XLA program reaches it through
+host callbacks:
+
+  * forward  — ``host_lookup_table`` op: ``jax.pure_callback`` gathers the
+    minibatch rows (the "pull"); only ``B×F×dim`` floats cross PCIe, never
+    the table.
+  * backward — a custom grad maker emits ``host_push_grad``:
+    ``jax.experimental.io_callback`` ships the sparse row grads back (the
+    "push") and the host applies SGD/Adagrad immediately (synchronous PS)
+    or on a background thread (``async_updates=True`` — the
+    AsyncCommunicator/Hogwild analog: bounded queue, lock-free reads,
+    locked row updates).
+
+To ride the Program-autodiff machinery (which only appends grad ops for ops
+with at least one differentiable input), every table gets a device-side
+``[1]``-float *anchor* parameter. The forward ignores it; the push op's
+io_callback returns the anchor's (zero) gradient so the callback is
+data-depended-on and never DCE'd by XLA.
+
+Scope: single-controller (one host). Multi-host row sharding (each process
+owns rows ``id % nprocs == rank``, lookups assembled with a psum over the
+host axis) is the documented next step in SCOPE.md; on-chip tables that fit
+HBM should use EP sharding (``models/deepfm.py:ep_param_rules``) instead.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..framework import grad_var_name
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class HostTable:
+    """A host-RAM (or memmapped) embedding table with a server-side optimizer.
+
+    The table is float32 on host regardless of the compute dtype: the push
+    applies high-precision updates (the reference pserver does the same;
+    bf16 grads are upcast on arrival).
+    """
+
+    def __init__(self, name: str, vocab_size: int, dim: int, *,
+                 optimizer: str = "adagrad", lr: float = 0.05,
+                 initializer=None, seed: int = 0, mmap_dir: Optional[str] = None,
+                 async_updates: bool = False, queue_size: int = 64):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"host table optimizer must be sgd|adagrad, "
+                             f"got {optimizer!r}")
+        self.name = name
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.mmap_dir = mmap_dir
+        shape = (self.vocab_size, self.dim)
+        if mmap_dir is not None:
+            os.makedirs(mmap_dir, exist_ok=True)
+            self.table = np.lib.format.open_memmap(
+                os.path.join(mmap_dir, f"{name}.table.npy"), mode="w+",
+                dtype=np.float32, shape=shape)
+            self._accum = np.lib.format.open_memmap(
+                os.path.join(mmap_dir, f"{name}.accum.npy"), mode="w+",
+                dtype=np.float32, shape=shape)
+            self._accum[:] = 0.0
+        else:
+            self.table = np.empty(shape, np.float32)
+            self._accum = np.zeros(shape, np.float32)
+        rng = np.random.RandomState(seed)
+        if initializer is None:
+            scale = 1.0 / np.sqrt(self.dim)
+            self.table[:] = rng.uniform(-scale, scale, shape).astype(np.float32)
+        elif callable(initializer):
+            self.table[:] = np.asarray(initializer(shape), np.float32)
+        else:
+            self.table[:] = np.asarray(initializer, np.float32).reshape(shape)
+        self._lock = threading.Lock()
+        self.push_count = 0
+        self._closed = False
+        self._worker_error: Optional[BaseException] = None
+        self._async = bool(async_updates)
+        self._queue: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        if self._async:
+            self._queue = queue.Queue(maxsize=queue_size)
+            self._worker = threading.Thread(target=self._drain, daemon=True,
+                                            name=f"host_table[{name}]")
+            self._worker.start()
+
+    # ---- pull ------------------------------------------------------------
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        """Lock-free read (Hogwild-style: concurrent async pushes may be
+        partially visible; exact under sync mode)."""
+        idx = np.clip(np.asarray(ids, np.int64), 0, self.vocab_size - 1)
+        return self.table[idx.reshape(-1)].reshape(idx.shape + (self.dim,))
+
+    # ---- push ------------------------------------------------------------
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        if self._closed:
+            raise RuntimeError(
+                f"host table {self.name!r} is closed; no more pushes accepted")
+        if self._worker_error is not None:
+            raise RuntimeError(
+                f"host table {self.name!r} async worker died: "
+                f"{self._worker_error!r}") from self._worker_error
+        if self._async:
+            self._queue.put((np.asarray(ids).copy(),
+                             np.asarray(grads, np.float32).copy()))
+        else:
+            self._apply(ids, grads)
+
+    def _drain(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                self._apply(*item)
+            except BaseException as e:  # poison, surface on next push/flush
+                self._worker_error = e
+                return
+            finally:
+                self._queue.task_done()
+
+    def flush(self):
+        """Barrier: wait until all queued async pushes are applied."""
+        if self._worker_error is not None:
+            raise RuntimeError(
+                f"host table {self.name!r} async worker died: "
+                f"{self._worker_error!r}") from self._worker_error
+        if self._async:
+            self._queue.join()
+
+    def close(self):
+        if self._async and self._worker is not None:
+            if self._worker_error is None:
+                self._queue.join()
+            self._queue.put(None)
+            self._worker.join(timeout=5)
+            self._worker = None
+        self._closed = True
+
+    def _apply(self, ids, grads):
+        ids = np.clip(np.asarray(ids, np.int64).reshape(-1), 0,
+                      self.vocab_size - 1)
+        g = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        # Duplicate ids in one minibatch sum their contributions first (the
+        # SelectedRows merge-add semantic) so the update matches the dense
+        # scatter-add a device-side table would apply.
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(acc, inv, g)
+        with self._lock:
+            if self.optimizer == "adagrad":
+                self._accum[uniq] += acc * acc
+                self.table[uniq] -= self.lr * acc / np.sqrt(
+                    self._accum[uniq] + 1e-10)
+            else:
+                self.table[uniq] -= self.lr * acc
+            self.push_count += 1
+
+    # ---- persistence -----------------------------------------------------
+    def save(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        self.flush()
+        with self._lock:
+            np.savez(os.path.join(dirname, f"host_table.{self.name}.npz"),
+                     table=np.asarray(self.table),
+                     accum=np.asarray(self._accum),
+                     meta=np.array([self.lr, self.push_count]))
+
+    def load(self, dirname: str):
+        data = np.load(os.path.join(dirname, f"host_table.{self.name}.npz"))
+        if data["table"].shape != (self.vocab_size, self.dim):
+            raise ValueError(
+                f"host table {self.name!r}: checkpoint shape "
+                f"{data['table'].shape} != declared "
+                f"{(self.vocab_size, self.dim)}")
+        with self._lock:
+            self.table[:] = data["table"]
+            self._accum[:] = data["accum"]
+            self.push_count = int(data["meta"][1])
+
+
+_TABLES: Dict[str, HostTable] = {}
+
+
+def create_table(name: str, vocab_size: int, dim: int, **kwargs) -> HostTable:
+    """Create (or fetch, with config check) the process-global table ``name``."""
+    t = _TABLES.get(name)
+    if t is not None:
+        if (t.vocab_size, t.dim) != (int(vocab_size), int(dim)):
+            raise ValueError(
+                f"host table {name!r} already exists with shape "
+                f"{(t.vocab_size, t.dim)}, requested {(vocab_size, dim)}")
+        existing = {"optimizer": t.optimizer, "lr": t.lr,
+                    "mmap_dir": t.mmap_dir, "async_updates": t._async}
+        for k, v in kwargs.items():
+            if k in existing and existing[k] != (
+                    float(v) if k == "lr" else v):
+                raise ValueError(
+                    f"host table {name!r} already exists with {k}="
+                    f"{existing[k]!r}; requested {v!r}. drop_table({name!r}) "
+                    f"first to rebuild it with a different config")
+        return t
+    t = HostTable(name, vocab_size, dim, **kwargs)
+    _TABLES[name] = t
+    return t
+
+
+def get_table(name: str) -> HostTable:
+    try:
+        return _TABLES[name]
+    except KeyError:
+        raise KeyError(
+            f"host table {name!r} does not exist in this process; create it "
+            f"with layers.host_embedding(...) / host_table.create_table() "
+            f"before building or deserializing the program") from None
+
+
+def drop_table(name: str):
+    t = _TABLES.pop(name, None)
+    if t is not None:
+        t.close()
+
+
+def save_all(dirname: str):
+    for t in _TABLES.values():
+        t.save(dirname)
+
+
+def load_all(dirname: str):
+    for t in _TABLES.values():
+        t.load(dirname)
+
+
+# --------------------------------------------------------------------------
+# ops
+# --------------------------------------------------------------------------
+
+# desc-level custom grad maker (reference GradOpDescMakerBase analog)
+def _host_lookup_grad_maker(op, grad_out_map):
+    out_name = op.outputs["Out"][0]
+    g = grad_out_map.get(out_name)
+    if g is None:
+        return []
+    return [{"type": "host_push_grad",
+             "inputs": {"Ids": list(op.inputs["Ids"]), "OutGrad": [g]},
+             "outputs": {"Anchor@GRAD": [grad_var_name(op.inputs["Anchor"][0])]},
+             "attrs": {"table_name": op.attrs["table_name"]}}]
+
+
+@register("host_lookup_table", grad=_host_lookup_grad_maker,
+          nondiff_inputs=("Ids",))
+def _host_lookup(ctx, ins):
+    """Pull: gather minibatch rows from the host table via pure_callback.
+
+    Anchor (a [1] device parameter) is ignored by the math; it exists so the
+    backward pass has a differentiable input to hang ``host_push_grad`` on.
+    """
+    import jax
+    jnp = _jnp()
+    ids = ins["Ids"][0]
+    if ids.ndim > 1 and ids.shape[-1] == 1:  # lookup_table squeeze parity
+        ids = ids.squeeze(-1)
+    name = ctx.attr("table_name")
+    dim = get_table(name).dim  # shape is config, safe to bind at trace time
+    dtype = ctx.attr("dtype", "float32")
+    out_struct = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,),
+                                      jnp.dtype(dtype))
+    # re-resolve by name inside the callback: a cached compiled program must
+    # see the table registered at RUN time (drop_table+create_table safe)
+    rows = jax.pure_callback(
+        lambda i: get_table(name).gather(i).astype(dtype), out_struct, ids)
+    return {"Out": [rows]}
+
+
+@register("host_push_grad", grad=None, nondiff_inputs=("Ids", "OutGrad"))
+def _host_push(ctx, ins):
+    """Push: ship sparse row grads to the host table; the host applies the
+    optimizer update (synchronous by default). Returns the anchor's zero
+    gradient *from the callback* so XLA cannot dead-code-eliminate the push.
+    """
+    import jax
+    from jax.experimental import io_callback
+    jnp = _jnp()
+    ids, g = ins["Ids"][0], ins["OutGrad"][0]
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    name = ctx.attr("table_name")
+    get_table(name)  # fail at trace time if missing
+
+    def push_cb(i, grad):
+        # late-bound by name (see _host_lookup)
+        get_table(name).push(i, grad)
+        return np.zeros((1,), np.float32)
+
+    token = io_callback(push_cb,
+                        jax.ShapeDtypeStruct((1,), jnp.float32),
+                        ids, g, ordered=False)
+    return {"Anchor@GRAD": [token]}

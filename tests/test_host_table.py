@@ -1,0 +1,161 @@
+"""Host-resident embedding table (the parameter-server analog, SCOPE gap #1).
+
+Reference behaviors covered: distributed lookup table pull/push
+(transpiler/distribute_transpiler.py:1594), server-side optimizer application
+(listen_and_serv optimize blocks), async communicator queueing
+(operators/distributed/communicator.h:276), checkpoint of server-held tables
+(io.py:328 _save_distributed_persistables).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.initializer import NumpyArrayInitializer
+from paddle_tpu.layer_helper import ParamAttr
+from paddle_tpu.ops import host_table as ht
+
+
+VOCAB, DIM, FIELDS = 40, 6, 3
+
+
+def _fresh(name):
+    ht.drop_table(name)
+    return name
+
+
+def _build(table_kind, name, w0, fc_w, lr=0.1, **table_kw):
+    """A tiny regression model over an embedding of kind 'host'|'device'."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[FIELDS], dtype="int64")
+        y = layers.data("y", shape=[1], dtype="float32")
+        if table_kind == "host":
+            emb = layers.host_embedding(ids, (VOCAB, DIM), name=name,
+                                        optimizer="sgd", learning_rate=lr,
+                                        initializer=w0, **table_kw)
+        else:
+            emb = layers.embedding(
+                ids, (VOCAB, DIM),
+                param_attr=ParamAttr(name="dev_w",
+                                     initializer=NumpyArrayInitializer(w0)))
+        flat = layers.reshape(emb, [-1, FIELDS * DIM])
+        pred = layers.fc(flat, 1, param_attr=ParamAttr(
+            name="fc_w", initializer=NumpyArrayInitializer(fc_w)),
+            bias_attr=False)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(steps, seed=7):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        # duplicate ids inside a batch on purpose: exercises the merge-add
+        ids = rng.randint(0, VOCAB, size=(4, FIELDS)).astype(np.int64)
+        ids[0, 0] = ids[1, 0]
+        out.append({"ids": ids, "y": rng.randn(4, 1).astype(np.float32)})
+    return out
+
+
+def test_host_vs_device_update_parity():
+    """Server-side SGD on the host table == on-device dense scatter-add SGD."""
+    rng = np.random.RandomState(0)
+    w0 = rng.uniform(-0.1, 0.1, (VOCAB, DIM)).astype(np.float32)
+    fc_w = rng.uniform(-0.1, 0.1, (FIELDS * DIM, 1)).astype(np.float32)
+
+    name = _fresh("parity_tbl")
+    h_main, h_start, h_loss = _build("host", name, w0, fc_w)
+    d_main, d_start, d_loss = _build("device", name, w0, fc_w)
+
+    exe = fluid.Executor()
+    scope_h, scope_d = fluid.Scope(), fluid.Scope()
+    feeds = _feeds(5)
+    with fluid.scope_guard(scope_h):
+        exe.run(h_start)
+        h_losses = [float(exe.run(h_main, feed=f, fetch_list=[h_loss])[0])
+                    for f in feeds]
+    with fluid.scope_guard(scope_d):
+        exe.run(d_start)
+        d_losses = [float(exe.run(d_main, feed=f, fetch_list=[d_loss])[0])
+                    for f in feeds]
+        dev_w = np.asarray(scope_d.find_var("dev_w"))
+
+    np.testing.assert_allclose(h_losses, d_losses, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(ht.get_table(name).table, dev_w,
+                               rtol=2e-5, atol=1e-6)
+    assert ht.get_table(name).push_count == len(feeds)
+    ht.drop_table(name)
+
+
+def test_push_op_in_backward_program():
+    """Transpiler-style assertion: the backward pass contains the push op."""
+    name = _fresh("desc_tbl")
+    main, _, _ = _build("host", name,
+                        np.zeros((VOCAB, DIM), np.float32),
+                        np.zeros((FIELDS * DIM, 1), np.float32))
+    types = [op.type for op in main.global_block().ops]
+    assert "host_lookup_table" in types and "host_push_grad" in types
+    # push consumes the loss cotangent of the lookup output
+    push = next(op for op in main.global_block().ops
+                if op.type == "host_push_grad")
+    assert push.attrs["table_name"] == name
+    ht.drop_table(name)
+
+
+def test_adagrad_server_optimizer():
+    name = _fresh("ada_tbl")
+    t = ht.create_table(name, 10, 4, optimizer="adagrad", lr=0.5,
+                        initializer=np.zeros((10, 4), np.float32))
+    g = np.ones((2, 4), np.float32)
+    t.push(np.array([3, 3]), g)  # merged: row 3 sees grad 2.0
+    # adagrad: accum = 4, update = 0.5 * 2 / sqrt(4) = 0.5
+    np.testing.assert_allclose(t.table[3], -0.5, rtol=1e-6)
+    assert np.abs(t.table[[0, 1, 2, 4]]).sum() == 0
+    ht.drop_table(name)
+
+
+def test_memmap_beyond_ram_mode(tmp_path):
+    name = _fresh("mm_tbl")
+    t = ht.create_table(name, 100, 8, optimizer="sgd", lr=1.0,
+                        mmap_dir=str(tmp_path))
+    assert isinstance(t.table, np.memmap)
+    before = t.table[5].copy()
+    t.push(np.array([5]), np.ones((1, 8), np.float32))
+    np.testing.assert_allclose(t.table[5], before - 1.0, rtol=1e-6)
+    ht.drop_table(name)
+
+
+def test_async_updates_flush():
+    name = _fresh("async_tbl")
+    t = ht.create_table(name, 20, 4, optimizer="sgd", lr=1.0,
+                        initializer=np.zeros((20, 4), np.float32),
+                        async_updates=True)
+    for _ in range(10):
+        t.push(np.array([1]), np.ones((1, 4), np.float32))
+    t.flush()
+    np.testing.assert_allclose(t.table[1], -10.0, rtol=1e-6)
+    ht.drop_table(name)
+
+
+def test_save_load_roundtrip(tmp_path):
+    name = _fresh("ckpt_tbl")
+    t = ht.create_table(name, 12, 3, optimizer="adagrad", lr=0.1)
+    t.push(np.array([2, 7]), np.ones((2, 3), np.float32))
+    snap = t.table.copy()
+    t.save(str(tmp_path))
+    t.push(np.array([2]), np.ones((1, 3), np.float32))
+    assert not np.allclose(t.table, snap)
+    t.load(str(tmp_path))
+    np.testing.assert_allclose(t.table, snap)
+    assert t.push_count == 1
+    ht.drop_table(name)
+
+
+def test_shape_mismatch_rejected():
+    name = _fresh("shape_tbl")
+    ht.create_table(name, 10, 4)
+    with pytest.raises(ValueError, match="already exists"):
+        ht.create_table(name, 10, 8)
+    ht.drop_table(name)
