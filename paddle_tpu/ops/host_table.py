@@ -75,6 +75,9 @@ class HostTable:
         self.optimizer = optimizer
         self.lr = float(lr)
         self.mmap_dir = mmap_dir
+        self._seed = seed
+        self._queue_size = queue_size
+        self._initializer = initializer
         shape = (self.vocab_size, self.dim)
         if mmap_dir is not None:
             os.makedirs(mmap_dir, exist_ok=True)
@@ -155,9 +158,12 @@ class HostTable:
 
     def close(self):
         if self._async and self._worker is not None:
-            if self._worker_error is None:
+            if self._worker_error is None and self._worker.is_alive():
                 self._queue.join()
-            self._queue.put(None)
+            try:  # a dead worker never drains; don't block on a full queue
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass
             self._worker.join(timeout=5)
             self._worker = None
         self._closed = True
@@ -204,6 +210,14 @@ class HostTable:
             self.push_count = int(data["meta"][1])
 
 
+def _same_init(a, b) -> bool:
+    if a is b:
+        return True
+    if isinstance(a, np.ndarray) and isinstance(b, np.ndarray):
+        return a.shape == b.shape and np.array_equal(a, b)
+    return False
+
+
 _TABLES: Dict[str, HostTable] = {}
 
 
@@ -216,9 +230,17 @@ def create_table(name: str, vocab_size: int, dim: int, **kwargs) -> HostTable:
                 f"host table {name!r} already exists with shape "
                 f"{(t.vocab_size, t.dim)}, requested {(vocab_size, dim)}")
         existing = {"optimizer": t.optimizer, "lr": t.lr,
-                    "mmap_dir": t.mmap_dir, "async_updates": t._async}
+                    "mmap_dir": t.mmap_dir, "async_updates": t._async,
+                    "seed": t._seed, "queue_size": t._queue_size}
         for k, v in kwargs.items():
-            if k in existing and existing[k] != (
+            if k == "initializer":
+                if v is not None and not _same_init(v, t._initializer):
+                    raise ValueError(
+                        f"host table {name!r} already exists with a "
+                        f"different initializer; drop_table({name!r}) first "
+                        f"to rebuild it (its current weights would otherwise "
+                        f"silently survive)")
+            elif k in existing and existing[k] != (
                     float(v) if k == "lr" else v):
                 raise ValueError(
                     f"host table {name!r} already exists with {k}="

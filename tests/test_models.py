@@ -184,3 +184,25 @@ def test_transformer_nmt_trains():
             lv, = exe.run(main, feed=feeds, fetch_list=[loss])
             losses.append(float(np.asarray(lv).reshape(())))
     assert losses[-1] < losses[0], losses
+
+
+def test_vgg16_trains():
+    """VGG-16 (the reference's published-benchmark workload) on tiny shapes."""
+    from paddle_tpu.models import vgg
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 32, 32], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss, acc, logits = vgg.vgg16(img, label, num_classes=10, use_bn=True)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    imgs = rng.uniform(0, 1, (8, 3, 32, 32)).astype(np.float32)
+    labels = rng.randint(0, 10, (8, 1)).astype(np.int64)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"img": imgs, "label": labels},
+                                fetch_list=[loss])[0]) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,65 @@
+"""YOLOv3 model family (reference: layers yolov3_loss/yolo_box users;
+PaddleCV yolov3). Tiny-scale configs so CPU tests stay fast."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import yolov3
+
+TINY = dict(scale=0.25, stage_blocks=(1, 1, 1, 1, 1), num_classes=4)
+
+
+def _gt(rng, n, b):
+    boxes = np.zeros((n, b, 4), np.float32)
+    # two real boxes per image, rest padded (zero area)
+    boxes[:, :2, :2] = rng.uniform(0.3, 0.6, (n, 2, 2))
+    boxes[:, :2, 2:] = rng.uniform(0.1, 0.25, (n, 2, 2))
+    labels = rng.randint(0, TINY["num_classes"], (n, b)).astype(np.int32)
+    return boxes, labels
+
+
+def test_yolov3_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 64, 64], "float32")
+        gt_box = fluid.data("gt_box", [6, 4], "float32")
+        gt_label = fluid.data("gt_label", [6], "int32")
+        loss = yolov3.yolov3(img, gt_box, gt_label, **TINY)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    imgs = rng.uniform(0, 1, (2, 3, 64, 64)).astype(np.float32)
+    boxes, labels = _gt(rng, 2, 6)
+    feed = {"img": imgs, "gt_box": boxes, "gt_label": labels}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_yolov3_infer_shapes():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 64, 64], "float32")
+        img_size = fluid.data("img_size", [2], "int32")
+        out, nums = yolov3.yolov3_infer(img, img_size, keep_top_k=20, **TINY)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        dets, counts = exe.run(
+            main,
+            feed={"img": rng.uniform(0, 1, (2, 3, 64, 64)).astype(np.float32),
+                  "img_size": np.full((2, 2), 64, np.int32)},
+            fetch_list=[out, nums])
+    assert dets.shape == (2, 20, 6)
+    assert counts.shape[0] == 2
+    # padding rows are labeled -1; kept rows have finite scores
+    for i in range(2):
+        k = int(counts[i])
+        assert 0 <= k <= 20
+        assert (dets[i, k:, 0] == -1).all()
