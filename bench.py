@@ -15,7 +15,8 @@ reference's fp32 CUDA path.
 
 Method notes:
   - bf16 activations/weights (MXU-native), f32 batch-norm statistics / loss.
-  - batches sized for per-chip throughput (ResNet 128, BERT 64): measured MFU
+  - batches sized for per-chip throughput (ResNet 128, BERT 128; both swept
+    each round -- larger regresses): measured MFU
     rises ~5 points over the V100-era batch sizes and vs_baseline compares
     throughput, which is the per-chip claim BASELINE.md makes.
   - feeds are pre-staged on device; this measures the compiled train-step (the
@@ -103,7 +104,7 @@ def bench_resnet50(batch=128, image=224, dtype="bfloat16"):
     return batch / per_step, per_step, flops
 
 
-def bench_bert_base(batch=64, seq=128, n_masks=20, dtype="bfloat16"):
+def bench_bert_base(batch=128, seq=128, n_masks=20, dtype="bfloat16"):
     """BERT-base (L12 H768 A12, vocab 30522) pretrain step: fwd+bwd+Adam."""
     import jax
     import paddle_tpu as fluid

@@ -14,50 +14,59 @@ from ..layer_helper import ParamAttr
 
 
 def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1, act=None,
-                  name=None):
+                  name=None, is_test=False):
     conv = layers.conv2d(input, num_filters, filter_size, stride=stride,
                          padding=(filter_size - 1) // 2, groups=groups,
                          bias_attr=False,
                          param_attr=ParamAttr(name=name + "_w" if name else None))
-    return layers.batch_norm(conv, act=act)
+    return layers.batch_norm(conv, act=act, is_test=is_test)
 
 
-def shortcut(input, ch_out, stride, name=None):
+def shortcut(input, ch_out, stride, name=None, is_test=False):
     ch_in = input.shape[1]
     if ch_in != ch_out or stride != 1:
-        return conv_bn_layer(input, ch_out, 1, stride, name=name)
+        return conv_bn_layer(input, ch_out, 1, stride, name=name,
+                             is_test=is_test)
     return input
 
 
-def bottleneck_block(input, num_filters, stride, name=None):
+def bottleneck_block(input, num_filters, stride, name=None, is_test=False):
     conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
-                          name=name and name + "_c0")
+                          name=name and name + "_c0", is_test=is_test)
     conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride, act="relu",
-                          name=name and name + "_c1")
-    conv2 = conv_bn_layer(conv1, num_filters * 4, 1, name=name and name + "_c2")
-    short = shortcut(input, num_filters * 4, stride, name=name and name + "_sc")
+                          name=name and name + "_c1", is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1,
+                          name=name and name + "_c2", is_test=is_test)
+    short = shortcut(input, num_filters * 4, stride,
+                     name=name and name + "_sc", is_test=is_test)
     return layers.relu(layers.elementwise_add(short, conv2))
 
 
 _DEPTHS = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
 
 
-def resnet(img, label, depth=50, num_classes=1000):
-    """Returns (loss, acc, logits). img: [N,3,H,W], label: [N,1] int64."""
+def resnet(img, label, depth=50, num_classes=1000, is_test=False):
+    """Returns (loss, acc, logits) — logits only if label is None.
+    img: [N,3,H,W], label: [N,1] int64. is_test freezes batch-norm to the
+    moving averages (the inference graph)."""
     stages = _DEPTHS[depth]
     filters = [64, 128, 256, 512]
-    h = conv_bn_layer(img, 64, 7, stride=2, act="relu", name="conv1")
+    h = conv_bn_layer(img, 64, 7, stride=2, act="relu", name="conv1",
+                      is_test=is_test)
     h = layers.pool2d(h, 3, "max", 2, pool_padding=1)
     for stage, (n_blocks, nf) in enumerate(zip(stages, filters)):
         for i in range(n_blocks):
             stride = 2 if i == 0 and stage > 0 else 1
-            h = bottleneck_block(h, nf, stride, name=f"res{stage}_{i}")
+            h = bottleneck_block(h, nf, stride, name=f"res{stage}_{i}",
+                                 is_test=is_test)
     h = layers.pool2d(h, pool_type="avg", global_pooling=True)
     logits = layers.fc(h, num_classes)
+    if label is None:
+        return logits
     loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
     acc = layers.accuracy(logits, label)
     return loss, acc, logits
 
 
-def resnet50(img, label, num_classes=1000):
-    return resnet(img, label, 50, num_classes)
+def resnet50(img, label, num_classes=1000, is_test=False):
+    return resnet(img, label, 50, num_classes, is_test=is_test)

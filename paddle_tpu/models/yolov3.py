@@ -123,5 +123,8 @@ def yolov3_infer(img, img_size, num_classes=80, scale=1.0,
         scores.append(layers.transpose(s, [0, 2, 1]))
     all_boxes = layers.concat(boxes, axis=1)
     all_scores = layers.concat(scores, axis=2)
+    # background_label=-1: YOLO classes are all real (class 0 = e.g. COCO
+    # person); the default 0 would silently suppress them
     return layers.multiclass_nms(all_boxes, all_scores, conf_thresh,
-                                 nms_top_k, keep_top_k, nms_thresh)
+                                 nms_top_k, keep_top_k, nms_thresh,
+                                 background_label=-1)

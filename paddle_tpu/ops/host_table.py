@@ -147,19 +147,29 @@ class HostTable:
             finally:
                 self._queue.task_done()
 
+    def _drain_wait(self):
+        """Wait for the queue to drain, polling worker liveness so a worker
+        that dies mid-wait cannot hang the caller (queue.join() would block
+        forever on the never-consumed remainder)."""
+        import time as _time
+        while self._queue.unfinished_tasks:
+            if self._worker_error is not None or self._worker is None \
+                    or not self._worker.is_alive():
+                break
+            _time.sleep(0.001)
+
     def flush(self):
         """Barrier: wait until all queued async pushes are applied."""
+        if self._async:
+            self._drain_wait()
         if self._worker_error is not None:
             raise RuntimeError(
                 f"host table {self.name!r} async worker died: "
                 f"{self._worker_error!r}") from self._worker_error
-        if self._async:
-            self._queue.join()
 
     def close(self):
         if self._async and self._worker is not None:
-            if self._worker_error is None and self._worker.is_alive():
-                self._queue.join()
+            self._drain_wait()
             try:  # a dead worker never drains; don't block on a full queue
                 self._queue.put_nowait(None)
             except queue.Full:

@@ -63,3 +63,17 @@ def test_yolov3_infer_shapes():
         k = int(counts[i])
         assert 0 <= k <= 20
         assert (dets[i, k:, 0] == -1).all()
+
+
+def test_yolov3_infer_keeps_class_zero():
+    """YOLO has no background class: the NMS must not suppress class 0
+    (regression: default background_label=0 silently dropped it)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 64, 64], "float32")
+        img_size = fluid.data("img_size", [2], "int32")
+        yolov3.yolov3_infer(img, img_size, keep_top_k=10, **TINY)
+    nms_ops = [op for op in main.global_block().ops
+               if op.type == "multiclass_nms"]
+    assert nms_ops and all(op.attrs["background_label"] == -1
+                           for op in nms_ops)
