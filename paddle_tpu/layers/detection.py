@@ -11,7 +11,8 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
            "multiclass_nms", "multiclass_nms2", "roi_align", "roi_pool",
            "anchor_generator", "box_clip", "bipartite_match",
            "target_assign", "ssd_loss", "sigmoid_focal_loss",
-           "detection_output", "density_prior_box", "generate_proposals", "rpn_target_assign", "yolov3_loss",
+           "detection_output", "density_prior_box", "generate_proposals",
+           "generate_proposal_labels", "rpn_target_assign", "yolov3_loss",
            "box_decoder_and_assign", "polygon_box_transform",
            "retinanet_detection_output", "multi_box_head"]
 
@@ -533,3 +534,57 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     variances = _concat(vars_, axis=0)
     return mbox_locs, mbox_confs, boxes, variances
 
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             rpn_rois_num=None, name=None):
+    """Reference detection.py:generate_proposal_labels (second-stage target
+    assignment). Fixed-shape TPU form: all R+G rows kept with ClsWeights
+    carrying the sampled fg/bg proportions (use_random accepted and
+    ignored); returns a 6-tuple — the reference's 5 outputs plus the
+    per-roi classification weights.
+
+    rpn_rois [N,R,4]; gt_classes [N,G]; is_crowd [N,G] or None;
+    gt_boxes [N,G,4]; im_info [N,3]; rpn_rois_num [N] masks proposal
+    padding rows (pass generate_proposals' RpnRoisNum).
+    """
+    if is_cls_agnostic or is_cascade_rcnn:
+        raise NotImplementedError(
+            "generate_proposal_labels: is_cls_agnostic / is_cascade_rcnn "
+            "modes are not built (class-specific targets with gts appended "
+            "only); see SCOPE.md detection row")
+    helper = LayerHelper("generate_proposal_labels", name=name)
+    C = int(class_nums or 81)
+    rois = _out(helper, rpn_rois.dtype, stop_gradient=True)
+    labels = _out(helper, "int32", stop_gradient=True)
+    cls_w = _out(helper, "float32", stop_gradient=True)
+    tgt = _out(helper, "float32", stop_gradient=True)
+    inw = _out(helper, "float32", stop_gradient=True)
+    outw = _out(helper, "float32", stop_gradient=True)
+    inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+              "GtBoxes": [gt_boxes], "ImInfo": [im_info]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    if rpn_rois_num is not None:
+        inputs["RpnRoisNum"] = [rpn_rois_num]
+    helper.append_op("generate_proposal_labels", inputs=inputs,
+                     outputs={"Rois": [rois], "LabelsInt32": [labels],
+                              "ClsWeights": [cls_w], "BboxTargets": [tgt],
+                              "BboxInsideWeights": [inw],
+                              "BboxOutsideWeights": [outw]},
+                     attrs={"batch_size_per_im": int(batch_size_per_im),
+                            "fg_fraction": float(fg_fraction),
+                            "fg_thresh": float(fg_thresh),
+                            "bg_thresh_hi": float(bg_thresh_hi),
+                            "bg_thresh_lo": float(bg_thresh_lo),
+                            "bbox_reg_weights": [float(w)
+                                                 for w in bbox_reg_weights],
+                            "class_nums": C})
+    blk = helper.main_program.current_block()
+    return (blk.var(rois.name), blk.var(labels.name), blk.var(tgt.name),
+            blk.var(inw.name), blk.var(outw.name), blk.var(cls_w.name))

@@ -7,3 +7,4 @@ from . import deepfm     # noqa: F401
 from . import transformer  # noqa: F401
 from . import vgg        # noqa: F401
 from . import yolov3     # noqa: F401
+from . import faster_rcnn  # noqa: F401

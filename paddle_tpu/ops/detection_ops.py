@@ -33,6 +33,24 @@ def iou_similarity(ctx, ins):
     return {"Out": [_iou_matrix(ins["X"][0], ins["Y"][0])]}
 
 
+def _encode_deltas(jnp, prior, gt, gt_norm=0.0):
+    """Center-form box deltas t such that decoding t against ``prior``
+    reproduces ``gt``. gt_norm=1.0 is the pixel (+1 width) convention whose
+    exact inverse is box_decoder_and_assign's decode (max coords get -1);
+    gt_norm=0.0 pairs with generate_proposals' decode. One shared encode so
+    a convention change cannot drift between ops."""
+    pw = jnp.maximum(prior[:, 2] - prior[:, 0], 1e-6)
+    ph = jnp.maximum(prior[:, 3] - prior[:, 1], 1e-6)
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0] + gt_norm, 1e-6)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1] + gt_norm, 1e-6)
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                      jnp.log(gw / pw), jnp.log(gh / ph)], 1)
+
+
 @register("box_coder", grad=None)
 def box_coder(ctx, ins):
     """box_coder_op.cc: encode divides the center-size offsets by the prior
@@ -608,20 +626,9 @@ def rpn_target_assign(ctx, ins):
                   (anchors[:, 2] < w + straddle) &
                   (anchors[:, 3] < h + straddle))
         labels = jnp.where(inside, labels, -1)
-    # encoded regression targets vs the matched gt
-    mg = gt[arg_gt]
-    aw = anchors[:, 2] - anchors[:, 0]
-    ah = anchors[:, 3] - anchors[:, 1]
-    acx = anchors[:, 0] + 0.5 * aw
-    acy = anchors[:, 1] + 0.5 * ah
-    gw = jnp.maximum(mg[:, 2] - mg[:, 0], 1e-6)
-    gh = jnp.maximum(mg[:, 3] - mg[:, 1], 1e-6)
-    gcx = mg[:, 0] + 0.5 * gw
-    gcy = mg[:, 1] + 0.5 * gh
-    tgt = jnp.stack([(gcx - acx) / jnp.maximum(aw, 1e-6),
-                     (gcy - acy) / jnp.maximum(ah, 1e-6),
-                     jnp.log(gw / jnp.maximum(aw, 1e-6)),
-                     jnp.log(gh / jnp.maximum(ah, 1e-6))], axis=1)
+    # encoded regression targets vs the matched gt (gt_norm=0: pairs with
+    # generate_proposals' decode)
+    tgt = _encode_deltas(jnp, anchors, gt[arg_gt], gt_norm=0.0)
     tgt = jnp.where(pos[:, None], tgt, 0.0)
     return {"Labels": [labels], "MatchedGt": [arg_gt],
             "BboxTargets": [tgt]}
@@ -818,3 +825,94 @@ def polygon_box_transform(ctx, ins):
                       jnp.broadcast_to(gy, x.shape))
     out = coord - x
     return {"Output": [out]}
+
+
+@register("generate_proposal_labels", grad=None,
+          nondiff_inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                          "ImInfo", "RpnRoisNum"))
+def generate_proposal_labels(ctx, ins):
+    """Second-stage target assignment (detection/generate_proposal_labels_op.cc):
+    append gt boxes to the proposals, match by IoU, label fg (>= fg_thresh)
+    with the gt class, bg in [bg_thresh_lo, bg_thresh_hi), ignore the rest.
+
+    The reference then RANDOM-samples batch_size_per_im rois at fg_fraction;
+    the fixed-shape TPU form keeps ALL R+G rows and emits ClsWeights scaled
+    so fg/bg contribute in the sampled proportions (the same shape-stable
+    deviation as rpn_target_assign). Proposal padding rows (index >=
+    RpnRoisNum) and padded gts (zero area) are ignored.
+
+    Batched: RpnRois [N,R,4], GtClasses [N,G] int32, IsCrowd [N,G] (opt),
+    GtBoxes [N,G,4], ImInfo [N,3] (unused; kept for signature parity),
+    RpnRoisNum [N] (opt). Outputs (R' = R+G): Rois [N,R',4],
+    LabelsInt32 [N,R'], ClsWeights [N,R'], BboxTargets [N,R',4C],
+    BboxInsideWeights / BboxOutsideWeights [N,R',4C].
+    """
+    import jax
+    jnp = _jnp()
+    rois = ins["RpnRois"][0]
+    gt_cls = ins["GtClasses"][0]
+    gt = ins["GtBoxes"][0]
+    is_crowd = ins.get("IsCrowd", [None])[0]
+    rois_num = ins.get("RpnRoisNum", [None])[0]
+    C = int(ctx.attr("class_nums", 81))
+    bpi = float(ctx.attr("batch_size_per_im", 256))
+    fg_frac = float(ctx.attr("fg_fraction", 0.25))
+    fg_th = float(ctx.attr("fg_thresh", 0.5))
+    bg_hi = float(ctx.attr("bg_thresh_hi", 0.5))
+    bg_lo = float(ctx.attr("bg_thresh_lo", 0.0))
+    rw = ctx.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    rw = jnp.asarray([float(w) for w in rw], jnp.float32)
+
+    def per_image(rois_i, gt_i, cls_i, crowd_i, nroi_i):
+        R = rois_i.shape[0]
+        all_rois = jnp.concatenate([rois_i, gt_i], 0)          # [R', 4]
+        Rp = all_rois.shape[0]
+        valid_gt = ((gt_i[:, 2] - gt_i[:, 0]) *
+                    (gt_i[:, 3] - gt_i[:, 1]) > 0) & (crowd_i == 0)
+        # pixel (+1) convention, like the reference op and the sibling
+        # generate_proposals NMS
+        iou = _iou_matrix(all_rois, gt_i, norm=1.0)            # [R', G]
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        max_iou = jnp.max(iou, axis=1)
+        matched = jnp.argmax(iou, axis=1)
+        fg = max_iou >= fg_th
+        bg = (max_iou < bg_hi) & (max_iou >= bg_lo) & ~fg
+        # proposal padding rows and padded-gt appendices are ignored
+        row_valid = jnp.concatenate(
+            [(jnp.arange(R) < nroi_i), valid_gt], 0)
+        fg, bg = fg & row_valid, bg & row_valid
+        label = jnp.where(fg, cls_i[matched],
+                          jnp.where(bg, 0, -1)).astype("int32")
+        # sampling -> weighting: match the sampled fg/bg proportions
+        n_fg = jnp.sum(fg).astype(jnp.float32)
+        n_bg = jnp.sum(bg).astype(jnp.float32)
+        fg_cap = jnp.minimum(fg_frac * bpi, n_fg)
+        bg_cap = jnp.minimum(bpi - fg_cap, n_bg)
+        w_fg = jnp.where(n_fg > 0, fg_cap / jnp.maximum(n_fg, 1.0), 0.0)
+        w_bg = jnp.where(n_bg > 0, bg_cap / jnp.maximum(n_bg, 1.0), 0.0)
+        cls_w = jnp.where(fg, w_fg, jnp.where(bg, w_bg, 0.0))
+        # encoded deltas vs matched gt, scattered into the class slice;
+        # gt_norm=1.0 makes box_decoder_and_assign's decode the EXACT
+        # inverse (train targets round-trip to the gt box at inference)
+        deltas = _encode_deltas(jnp, all_rois, gt_i[matched],
+                                gt_norm=1.0) / rw
+        onehot = jax.nn.one_hot(jnp.where(fg, label, 0), C,
+                                dtype=jnp.float32) * fg[:, None]  # [R', C]
+        tgt = (onehot[:, :, None] * deltas[:, None, :]).reshape(Rp, 4 * C)
+        inw = jnp.repeat(onehot, 4, axis=1).reshape(Rp, 4 * C)
+        outw = inw * cls_w[:, None]
+        return (all_rois, label, cls_w.astype(jnp.float32),
+                tgt.astype(jnp.float32), inw, outw)
+
+    N, R = rois.shape[0], rois.shape[1]
+    G = gt.shape[1]
+    crowd = (is_crowd.astype("int32") if is_crowd is not None
+             else jnp.zeros((N, G), jnp.int32))
+    nroi = (rois_num.astype("int32") if rois_num is not None
+            else jnp.full((N,), R, jnp.int32))
+    outs = jax.vmap(per_image)(rois.astype(jnp.float32),
+                               gt.astype(jnp.float32),
+                               gt_cls.astype("int32"), crowd, nroi)
+    names = ["Rois", "LabelsInt32", "ClsWeights", "BboxTargets",
+             "BboxInsideWeights", "BboxOutsideWeights"]
+    return {n: [o] for n, o in zip(names, outs)}

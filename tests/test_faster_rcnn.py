@@ -1,0 +1,129 @@
+"""Faster R-CNN two-stage family: generate_proposal_labels op + full model.
+
+Reference: operators/detection/generate_proposal_labels_op.cc and the
+detection layer suite it completes."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import faster_rcnn
+
+TINY = dict(scale=0.125, stage_blocks=(1, 1, 1), num_classes=5,
+            anchor_sizes=(32, 64), aspect_ratios=(1.0,), post_nms_top_n=16)
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feeds, fetch_list=fetches)
+
+
+def test_generate_proposal_labels_semantics():
+    A = dict(append_batch_size=False)
+    rois_np = np.array([[[0, 0, 10, 10],     # IoU 1.0 with gt0 -> fg
+                         [0, 0, 9, 11],      # high IoU with gt0 -> fg
+                         [30, 30, 42, 40],   # overlaps gt1 partially
+                         [60, 60, 70, 70],   # no overlap -> bg
+                         [0, 0, 0, 0]]],     # padding row (index >= num)
+                       np.float32)
+    gt_np = np.array([[[0, 0, 10, 10], [30, 30, 40, 40]]], np.float32)
+    cls_np = np.array([[2, 4]], np.int32)
+    num_np = np.array([4], np.int64)
+
+    def build():
+        rois = fluid.data("rois", [1, 5, 4], "float32", **A)
+        gt = fluid.data("gt", [1, 2, 4], "float32", **A)
+        cls = fluid.data("cls", [1, 2], "int32", **A)
+        num = fluid.data("num", [1], "int64", **A)
+        im = fluid.data("im", [1, 3], "float32", **A)
+        outs = layers.generate_proposal_labels(
+            rois, cls, None, gt, im, class_nums=5, fg_thresh=0.5,
+            bg_thresh_hi=0.5, bg_thresh_lo=0.0, rpn_rois_num=num)
+        return list(outs)
+
+    feeds = {"rois": rois_np, "gt": gt_np, "cls": cls_np, "num": num_np,
+             "im": np.array([[80, 80, 1.0]], np.float32)}
+    s_rois, labels, tgt, inw, outw, clsw = _run(build, feeds)
+    # R' = 5 proposals + 2 appended gts
+    assert s_rois.shape == (1, 7, 4) and labels.shape == (1, 7)
+    # appended gts are perfect matches -> fg with their own class
+    assert labels[0, 5] == 2 and labels[0, 6] == 4
+    # proposal 0/1 match gt0 (class 2); proposal 3 is background
+    assert labels[0, 0] == 2 and labels[0, 1] == 2
+    assert labels[0, 3] == 0
+    # padding row is ignored with zero weight
+    assert labels[0, 4] == -1 and clsw[0, 4] == 0.0
+    # fg rows put bbox weights exactly on their class slice
+    assert inw[0, 0, 2 * 4:3 * 4].sum() == 4.0
+    assert inw[0, 0].sum() == 4.0
+    # pixel (+1) convention: targets are the EXACT inverse of
+    # box_decoder_and_assign's decode. For roi == gt == [0,0,10,10]:
+    # pw=10, gw=11, gcx=5.5, pcx=5 -> t=[0.05, 0.05, log(1.1), log(1.1)],
+    # then divided by the reg weights [0.1, 0.1, 0.2, 0.2]
+    expect = np.array([0.5, 0.5, np.log(1.1) / 0.2, np.log(1.1) / 0.2],
+                      np.float32)
+    np.testing.assert_allclose(tgt[0, 0, 2 * 4:3 * 4], expect, rtol=1e-5)
+    # fg weights positive, ignore weights zero
+    assert clsw[0, 0] > 0 and clsw[0, 3] > 0
+
+
+def test_faster_rcnn_trains():
+    N = 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        img = fluid.data("img", [N, 3, 64, 64], "float32", **A)
+        gt_box = fluid.data("gt_box", [N, 3, 4], "float32", **A)
+        gt_label = fluid.data("gt_label", [N, 3], "int32", **A)
+        im_info = fluid.data("im_info", [N, 3], "float32", **A)
+        total, rpn_loss, head_loss = faster_rcnn.faster_rcnn(
+            img, gt_box, gt_label, im_info, batch_size=N, **TINY)
+        fluid.optimizer.Adam(1e-3).minimize(total)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    boxes = np.zeros((N, 3, 4), np.float32)
+    boxes[:, 0] = [8, 8, 28, 28]
+    boxes[:, 1] = [36, 30, 60, 50]
+    feeds = {"img": rng.uniform(0, 1, (N, 3, 64, 64)).astype(np.float32),
+             "gt_box": boxes,
+             "gt_label": rng.randint(1, 5, (N, 3)).astype(np.int32),
+             "im_info": np.tile(np.array([[64, 64, 1.0]], np.float32),
+                                (N, 1))}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(
+                      exe.run(main, feed=feeds, fetch_list=[total])[0])
+                      .reshape(()))
+                  for _ in range(8)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_faster_rcnn_infer_shapes():
+    N = 1
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        img = fluid.data("img", [N, 3, 64, 64], "float32", **A)
+        im_info = fluid.data("im_info", [N, 3], "float32", **A)
+        dets, nums = faster_rcnn.faster_rcnn_infer(
+            img, im_info, batch_size=N, keep_top_k=20, **TINY)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, counts = exe.run(
+            main,
+            feed={"img": rng.uniform(0, 1, (N, 3, 64, 64)).astype(np.float32),
+                  "im_info": np.array([[64, 64, 1.0]], np.float32)},
+            fetch_list=[dets, nums])
+    assert out.shape == (N, 20, 6)
+    k = int(counts[0])
+    assert 0 <= k <= 20
+    assert (out[0, k:, 0] == -1).all()
