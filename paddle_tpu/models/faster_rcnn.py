@@ -58,9 +58,13 @@ def _box_head(feat5d, num_classes, scale=1.0):
 
 
 def _anchors(feat, anchor_sizes, aspect_ratios):
+    # variance = 1: rpn_target_assign trains RAW deltas, and
+    # generate_proposals decodes d * variance — the standard RPN setting.
+    # The default (0.1, 0.1, 0.2, 0.2) would shrink decoded proposals 10x.
     anchors, variances = layers.anchor_generator(
         feat, anchor_sizes=list(anchor_sizes),
-        aspect_ratios=list(aspect_ratios), stride=[16.0, 16.0])
+        aspect_ratios=list(aspect_ratios), stride=[16.0, 16.0],
+        variance=(1.0, 1.0, 1.0, 1.0))
     return anchors, variances
 
 
@@ -182,10 +186,21 @@ def faster_rcnn_infer(img, im_info, batch_size, num_classes=81, scale=1.0,
                                 (batch_size * Rp, 1)))
     _, best_box = layers.box_decoder_and_assign(flat_rois, var, head_bbox,
                                                 probs)
-    # NMS over each roi's best-class box with per-class scores
-    scores = layers.transpose(
-        layers.reshape(probs, [batch_size, Rp, num_classes]), [0, 2, 1])
-    best_box = layers.reshape(best_box, [batch_size, Rp, 4])
+    # NMS over each roi's best-class box with per-class scores; proposal
+    # padding rows (index >= rois_num) are masked to score 0 so degenerate
+    # [0,0,-1,-1] boxes can never surface as detections
+    scores = layers.reshape(probs, [batch_size, Rp, num_classes])
+    idx = layers.assign(np.arange(Rp, dtype=np.int64).reshape(1, Rp))
+    valid = layers.cast(
+        layers.less_than(idx, layers.reshape(
+            layers.cast(rois_num, "int64"), [batch_size, 1])), "float32")
+    scores = layers.elementwise_mul(scores, layers.reshape(
+        valid, [batch_size, Rp, 1]))
+    scores = layers.transpose(scores, [0, 2, 1])
+    # clip decoded boxes to the image (reference detectors clip before NMS;
+    # an untrained/edge box can otherwise decode outside the canvas)
+    best_box = layers.box_clip(
+        layers.reshape(best_box, [batch_size, Rp, 4]), im_info)
     return layers.multiclass_nms(best_box, scores, score_thresh,
                                  nms_top_k=post_nms_top_n,
                                  keep_top_k=keep_top_k,

@@ -31,10 +31,14 @@ with at least one differentiable input), every table gets a device-side
 io_callback returns the anchor's (zero) gradient so the callback is
 data-depended-on and never DCE'd by XLA.
 
-Scope: single-controller (one host). Multi-host row sharding (each process
-owns rows ``id % nprocs == rank``, lookups assembled with a psum over the
-host axis) is the documented next step in SCOPE.md; on-chip tables that fit
-HBM should use EP sharding (``models/deepfm.py:ep_param_rules``) instead.
+Multi-host: works as the classic single-pserver topology with no extra
+code — under multi-host GSPMD, jax gathers callback operands to process 0,
+runs the callback there alone, and broadcasts the result, so process 0's
+host RAM/memmap is the parameter server (tested: 2-process loss parity and
+pserver-rank push accounting in tests/test_multihost.py). Checkpoint host
+tables from process 0 (the only rank whose table advances). On-chip tables
+that fit HBM should use EP sharding (``models/deepfm.py:ep_param_rules``)
+instead.
 """
 from __future__ import annotations
 

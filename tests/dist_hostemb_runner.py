@@ -1,0 +1,72 @@
+"""Multi-process host-table trainer (launched by test_multihost.py).
+
+Under multi-host GSPMD, jax gathers callback operands to process 0, runs the
+callback there alone, and broadcasts the result — so process 0's host RAM is
+the single parameter server (the classic pserver topology, reference
+transpiler/distribute_transpiler.py:3.3 call stack) with ZERO extra code.
+This runner trains a host_embedding model data-parallel across N processes
+and prints per-step losses; the parent asserts parity with the 1-process
+run and that only rank 0's table was touched.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import env as penv
+    from paddle_tpu.ops import host_table as ht
+
+    if nproc > 1:
+        penv.init_parallel_env(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=rank)
+
+    VOCAB, DIM, F = 64, 8, 4
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 11
+    startup.random_seed = 11
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        ids = fluid.data("ids", [F], "int64")
+        y = fluid.data("y", [1], "float32")
+        emb = fluid.layers.host_embedding(ids, (VOCAB, DIM), name="mh_tbl",
+                                          optimizer="sgd", learning_rate=0.2,
+                                          seed=3)
+        pred = fluid.layers.fc(fluid.layers.reshape(emb, [-1, F * DIM]), 1)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    cp = fluid.CompiledProgram(main_p).with_data_parallel(loss_name=loss.name)
+
+    rng = np.random.RandomState(5)  # same global stream on every rank
+    truth = rng.randn(VOCAB).astype(np.float32)
+
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(6):
+            gids = rng.randint(0, VOCAB, (8, F)).astype(np.int64)
+            gy = truth[gids].sum(1, keepdims=True).astype(np.float32)
+            lids = penv.shard_batch(gids, rank, nproc)
+            ly = penv.shard_batch(gy, rank, nproc)
+            lv, = exe.run(cp, feed={"ids": lids, "y": ly}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    print("LOSSES:" + json.dumps(losses), flush=True)
+    print("PUSHES:" + str(ht.get_table("mh_tbl").push_count), flush=True)
+
+
+if __name__ == "__main__":
+    main()

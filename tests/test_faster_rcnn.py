@@ -127,3 +127,11 @@ def test_faster_rcnn_infer_shapes():
     k = int(counts[0])
     assert 0 <= k <= 20
     assert (out[0, k:, 0] == -1).all()
+    # padded proposals decode to zero-area boxes; the rois_num score mask
+    # must keep them out of the detections, and boxes are image-clipped
+    kept = out[0, :k]
+    if k:
+        areas = (np.maximum(kept[:, 4] - kept[:, 2], 0) *
+                 np.maximum(kept[:, 5] - kept[:, 3], 0))
+        assert (areas > 1e-6).all()
+        assert (kept[:, 2:] >= 0).all() and (kept[:, 2:] <= 64).all()

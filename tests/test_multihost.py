@@ -26,7 +26,7 @@ def _free_port():
     return port
 
 
-def _launch(nproc, port, ckpt_dir=None):
+def _launch(nproc, port, ckpt_dir=None, runner=_RUNNER):
     procs = []
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
@@ -34,7 +34,7 @@ def _launch(nproc, port, ckpt_dir=None):
     extra = [str(ckpt_dir)] if ckpt_dir else []
     for r in range(nproc):
         procs.append(subprocess.Popen(
-            [sys.executable, _RUNNER, str(r), str(nproc), str(port)] + extra,
+            [sys.executable, runner, str(r), str(nproc), str(port)] + extra,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env))
     outs = []
     for p in procs:
@@ -131,3 +131,22 @@ def test_shard_batch():
     x = np.arange(12).reshape(12, 1)
     np.testing.assert_array_equal(shard_batch(x, 1, 3), x[4:8])
     np.testing.assert_array_equal(shard_batch(x, 0, 1), x)
+
+
+def test_two_process_host_table_is_single_pserver():
+    """host_embedding under multi-host dp: jax gathers callback operands to
+    process 0 and runs the pull/push there alone — process 0's host RAM is
+    the parameter server. Losses must match the single-process run and only
+    rank 0 may apply pushes."""
+    runner = os.path.join(os.path.dirname(__file__), "dist_hostemb_runner.py")
+    single = _launch(1, _free_port(), runner=runner)
+    multi = _launch(2, _free_port(), runner=runner)
+
+    l1 = _tagged(single[0], "LOSSES")
+    np.testing.assert_allclose(l1, _tagged(multi[0], "LOSSES"),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(l1, _tagged(multi[1], "LOSSES"),
+                               rtol=1e-4, atol=1e-5)
+    # the pserver is process 0: it applied every step's push, rank 1 none
+    assert _tagged(multi[0], "PUSHES") == 6
+    assert _tagged(multi[1], "PUSHES") == 0
