@@ -1,0 +1,134 @@
+"""Throughput for the remaining BASELINE workload configs.
+
+BASELINE.md names five workloads the rebuild must run end-to-end; bench.py
+covers ResNet-50 and BERT-base (+ the collective line), bench_inference.py
+the published inference latencies. This script measures the other two
+training paths on the attached TPU:
+
+  - Transformer NMT (base config, seq 64+64) — tokens/sec, fwd+bwd+Adam
+  - DeepFM CTR (vocab 1M, 26 sparse fields) — examples/sec, fwd+bwd+Adam
+
+No published reference numbers exist for these (vs_baseline: null); the
+lines exist so every BASELINE workload has a measured, regression-trackable
+number. Same relay-safe two-segment timing as bench.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from bench import _timed_steps, _sync, _peak
+
+
+def bench_transformer(batch=64, seq=64):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(src_vocab=32000, trg_vocab=32000,
+                                        hidden=512, n_layers=6, n_heads=8,
+                                        ffn_hidden=2048, dropout=0.1)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        S = seq
+        src = fluid.data("src", [batch, S], "int64", **A)
+        spos = fluid.data("spos", [batch, S], "int64", **A)
+        smask = fluid.data("smask", [batch, S], "float32", **A)
+        trg = fluid.data("trg", [batch, S], "int64", **A)
+        tpos = fluid.data("tpos", [batch, S], "int64", **A)
+        tmask = fluid.data("tmask", [batch, S], "float32", **A)
+        lbl = fluid.data("lbl", [batch, S], "int64", **A)
+        loss, _ = transformer.transformer(src, spos, smask, trg, tpos, tmask,
+                                          lbl, cfg, label_smooth_eps=0.1)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    pos = np.tile(np.arange(seq, dtype=np.int32), (batch, 1))
+    ids = lambda hi, shape: jax.device_put(
+        rng.randint(0, hi, shape).astype(np.int32))
+    ones = jax.device_put(np.ones((batch, seq), np.float32))
+    feed = {"src": ids(cfg.src_vocab, (batch, seq)),
+            "spos": jax.device_put(pos), "smask": ones,
+            "trg": ids(cfg.trg_vocab, (batch, seq)),
+            "tpos": jax.device_put(pos), "tmask": ones,
+            "lbl": ids(cfg.trg_vocab, (batch, seq))}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[], return_numpy=False)
+        scope = fluid.global_scope()
+        _sync(scope.find_var("src_emb"))
+        per_step = _timed_steps(
+            lambda: exe.run(main, feed=feed, fetch_list=[],
+                            return_numpy=False),
+            lambda: scope.find_var("src_emb"))
+    # source + target tokens processed per step
+    return 2 * batch * seq / per_step, per_step
+
+
+def bench_deepfm(batch=4096, fields=26, vocab=1_000_000, embed=16):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import deepfm
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        ids = fluid.data("ids", [batch, fields], "int64", **A)
+        dense = fluid.data("dense", [batch, 13], "float32", **A)
+        label = fluid.data("label", [batch, 1], "int64", **A)
+        loss, auc, _ = deepfm.deepfm(ids, dense, label, num_fields=fields,
+                                     vocab_size=vocab, embed_dim=embed)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    feed = {"ids": jax.device_put(
+                rng.randint(0, vocab, (batch, fields)).astype(np.int32)),
+            "dense": jax.device_put(rng.rand(batch, 13).astype(np.float32)),
+            "label": jax.device_put(
+                rng.randint(0, 2, (batch, 1)).astype(np.int32))}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[], return_numpy=False)
+        scope = fluid.global_scope()
+        _sync(scope.find_var("fm_v"))
+        per_step = _timed_steps(
+            lambda: exe.run(main, feed=feed, fetch_list=[],
+                            return_numpy=False),
+            lambda: scope.find_var("fm_v"))
+    return batch / per_step, per_step
+
+
+def main():
+    _, kind = _peak()
+    tps, dt = bench_transformer()
+    print(json.dumps({"metric": "transformer_nmt_tokens_per_sec",
+                      "value": round(tps, 1),
+                      "unit": "tokens/sec (base cfg f32, seq 64+64)",
+                      "vs_baseline": None,
+                      "step_time_ms": round(dt * 1e3, 2),
+                      "device_kind": kind}), flush=True)
+    eps, dt = bench_deepfm()
+    print(json.dumps({"metric": "deepfm_ctr_examples_per_sec",
+                      "value": round(eps, 1),
+                      "unit": "examples/sec (vocab 1M, 26 fields)",
+                      "vs_baseline": None,
+                      "step_time_ms": round(dt * 1e3, 2),
+                      "device_kind": kind}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -197,10 +197,15 @@ def faster_rcnn_infer(img, im_info, batch_size, num_classes=81, scale=1.0,
     scores = layers.elementwise_mul(scores, layers.reshape(
         valid, [batch_size, Rp, 1]))
     scores = layers.transpose(scores, [0, 2, 1])
-    # clip decoded boxes to the image (reference detectors clip before NMS;
-    # an untrained/edge box can otherwise decode outside the canvas)
-    best_box = layers.box_clip(
-        layers.reshape(best_box, [batch_size, Rp, 4]), im_info)
+    # reference flow: boxes decode in network-input coords; divide by the
+    # im_info scale into ORIGINAL-image space, then clip to those bounds
+    # (box_clip clips to round(h/scale)-1 — clipping network-space boxes
+    # directly would truncate valid detections whenever scale != 1)
+    inv_scale = layers.reshape(
+        layers.slice(im_info, [1], [2], [3]), [batch_size, 1, 1])
+    best_box = layers.elementwise_div(
+        layers.reshape(best_box, [batch_size, Rp, 4]), inv_scale)
+    best_box = layers.box_clip(best_box, im_info)
     return layers.multiclass_nms(best_box, scores, score_thresh,
                                  nms_top_k=post_nms_top_n,
                                  keep_top_k=keep_top_k,

@@ -116,22 +116,25 @@ def test_faster_rcnn_infer_shapes():
             img, im_info, batch_size=N, keep_top_k=20, **TINY)
     exe = fluid.Executor()
     rng = np.random.RandomState(1)
+    # scale=2: network input is a 2x-resized 32x32 original; detections come
+    # back in ORIGINAL-image coordinates (reference im_info semantics)
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
         out, counts = exe.run(
             main,
             feed={"img": rng.uniform(0, 1, (N, 3, 64, 64)).astype(np.float32),
-                  "im_info": np.array([[64, 64, 1.0]], np.float32)},
+                  "im_info": np.array([[64, 64, 2.0]], np.float32)},
             fetch_list=[dets, nums])
     assert out.shape == (N, 20, 6)
     k = int(counts[0])
     assert 0 <= k <= 20
     assert (out[0, k:, 0] == -1).all()
     # padded proposals decode to zero-area boxes; the rois_num score mask
-    # must keep them out of the detections, and boxes are image-clipped
+    # must keep them out of the detections, and boxes land clipped inside
+    # the 32x32 ORIGINAL image, not the 64x64 network canvas
     kept = out[0, :k]
     if k:
         areas = (np.maximum(kept[:, 4] - kept[:, 2], 0) *
                  np.maximum(kept[:, 5] - kept[:, 3], 0))
         assert (areas > 1e-6).all()
-        assert (kept[:, 2:] >= 0).all() and (kept[:, 2:] <= 64).all()
+        assert (kept[:, 2:] >= 0).all() and (kept[:, 2:] <= 32).all()
