@@ -1,0 +1,93 @@
+"""Fused dynamic-int8 matmul Pallas kernel.
+
+Closes the gap documented in contrib/quantize.py round 3: the XLA int8
+compute path (quantize pass -> int8 dot -> rescale pass) measured 0.73x
+bf16 on v5e because the quantize/rescale passes are extra HBM round-trips.
+This kernel fuses them: per-row activation scales are one cheap XLA reduce;
+the kernel then quantizes each [BM, K] activation block ONCE into VMEM
+scratch (at the first N-tile; reused across the row of N-tiles), runs the
+int8 x int8 MXU dot, and rescales to the compute dtype on the way out.
+
+MEASURED (v5e, 4096^3, bf16 activations): 1.04x bf16 with int8 weights
+(plus the 4x weight-HBM/checkpoint shrink) vs 0.73x for the unfused path.
+
+Reference analog: the int8 compute mode contrib/slim's fake-quant pairs
+simulate (slim/quantization/quantization_pass.py); here it is a real fused
+kernel, selected automatically by the quantized_mul lowering on supported
+shapes (ops fall back to the XLA path elsewhere, including CPU tests which
+run this kernel in interpret mode for parity).
+"""
+from __future__ import annotations
+
+BM = 256
+BN = 256
+# the double-buffered [BM, K] activation block dominates VMEM: with the
+# int8 scratch, weight blocks, and output tile, K*itemsize must stay under
+# ~16KB per BM row — 8k for <=2-byte activations, 4k for f32
+MAX_K_2BYTE = 8192
+
+
+def supports_fused(m: int, k: int, n: int, itemsize: int = 2) -> bool:
+    return k <= MAX_K_2BYTE * 2 // max(itemsize, 2) and m >= 8
+
+
+def _kernel(xs_ref, x_ref, w_ref, ws_ref, o_ref, xq_ref):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _quantize_block():
+        x = x_ref[...].astype(jnp.float32)
+        xq_ref[...] = jnp.clip(jnp.round(x / xs_ref[...]),
+                               -127, 127).astype(jnp.int8)
+
+    acc = jax.lax.dot_general(xq_ref[...], w_ref[...],
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    o_ref[...] = (acc.astype(jnp.float32) * xs_ref[...] *
+                  ws_ref[...]).astype(o_ref.dtype)
+
+
+def fused_int8_matmul(x2, w8, wscale, interpret: bool = False):
+    """x2 [M, K] float/bf16; w8 [K, N] int8; wscale [N] f32 -> [M, N] x2.dtype.
+
+    Activation scales are dynamic per ROW (tighter than the per-tensor scale
+    of the unfused path). Inputs are zero-padded to the block grid; padding
+    contributes exact zeros to the dot and is sliced off.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = x2.shape
+    N = w8.shape[1]
+    xs = (jnp.max(jnp.abs(x2.astype(jnp.float32)), axis=1, keepdims=True)
+          / 127.0)
+    xs = jnp.maximum(xs, 1e-12)
+
+    Mp = -(-M // BM) * BM
+    Np = -(-N // BN) * BN
+    Kp = -(-K // 128) * 128
+    xp = jnp.pad(x2, ((0, Mp - M), (0, Kp - K)))
+    xsp = jnp.pad(xs, ((0, Mp - M), (0, 0)), constant_values=1.0)
+    wp = jnp.pad(w8, ((0, Kp - K), (0, Np - N)))
+    wsp = jnp.pad(wscale.reshape(1, -1).astype(jnp.float32),
+                  ((0, 0), (0, Np - N)))
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(Mp // BM, Np // BN),
+        in_specs=[pl.BlockSpec((BM, 1), lambda i, j: (i, 0)),
+                  pl.BlockSpec((BM, Kp), lambda i, j: (i, 0)),
+                  pl.BlockSpec((Kp, BN), lambda i, j: (0, j)),
+                  pl.BlockSpec((1, BN), lambda i, j: (0, j))],
+        out_specs=pl.BlockSpec((BM, BN), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), x2.dtype),
+        scratch_shapes=[pltpu.VMEM((BM, Kp), jnp.int8)],
+        interpret=interpret,
+    )(xsp, xp, wp, wsp)
+    return out[:M, :N]

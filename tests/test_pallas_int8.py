@@ -1,0 +1,72 @@
+"""Fused dynamic-int8 Pallas matmul (ops/pallas_int8.py): numeric parity
+with the unfused XLA path, padding correctness on non-block shapes, and the
+shape gate. CPU runs the kernel in interpret mode — same code path the TPU
+compiles."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import pallas_int8
+
+
+def _unfused(x2, w8, wscale):
+    xs = jnp.maximum(jnp.max(jnp.abs(x2.astype(jnp.float32)), axis=1,
+                             keepdims=True) / 127.0, 1e-12)
+    xq = jnp.clip(jnp.round(x2.astype(jnp.float32) / xs),
+                  -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, w8, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * (xs * wscale[None, :])).astype(x2.dtype)
+
+
+def _setup(m, k, n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+    wf = rng.randn(k, n).astype(np.float32)
+    ws = np.abs(wf).max(0) / 127.0 + 1e-12
+    w8 = jnp.asarray(np.clip(np.round(wf / ws), -127, 127), jnp.int8)
+    return x, w8, jnp.asarray(ws, jnp.float32)
+
+
+def test_fused_matches_unfused_block_aligned():
+    x, w8, ws = _setup(256, 256, 256)
+    got = pallas_int8.fused_int8_matmul(x, w8, ws, interpret=True)
+    want = _unfused(x, w8, ws)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_padding_path_odd_shapes():
+    # M/K/N all off the block grid: exercises zero-padding + slice-off
+    x, w8, ws = _setup(70, 300, 130, seed=3)
+    got = pallas_int8.fused_int8_matmul(x, w8, ws, interpret=True)
+    want = _unfused(x, w8, ws)
+    assert got.shape == (70, 130)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_integer_inputs_are_exact():
+    """True int32-accumulator exactness vs a numpy oracle: rows whose
+    abs-max is exactly 127 quantize with scale 1.0, so the kernel's output
+    must equal the exact integer matmul — no tolerance, and independent of
+    the unfused jax path (a shared f32-accumulation bug cannot hide)."""
+    rng = np.random.RandomState(1)
+    xi = rng.randint(-126, 127, (64, 128)).astype(np.int64)
+    xi[:, 0] = 127                      # force per-row scale = 127/127 = 1
+    w = rng.randint(-127, 127, (128, 64)).astype(np.int64)
+    got = np.asarray(pallas_int8.fused_int8_matmul(
+        jnp.asarray(xi, jnp.float32), jnp.asarray(w, jnp.int8),
+        jnp.ones((64,), jnp.float32), interpret=True))
+    want = (xi @ w).astype(np.float32)  # exact integer oracle
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gate_rejects_huge_k_tiny_m_and_f32_budget():
+    assert not pallas_int8.supports_fused(128, pallas_int8.MAX_K_2BYTE + 1,
+                                          128, itemsize=2)
+    assert not pallas_int8.supports_fused(4, 128, 128)
+    assert pallas_int8.supports_fused(64, 4096, 1024, itemsize=2)
+    # f32 activations halve the K budget (VMEM)
+    assert not pallas_int8.supports_fused(64, 8192, 1024, itemsize=4)
+    assert pallas_int8.supports_fused(64, 4096, 1024, itemsize=4)
