@@ -27,7 +27,9 @@ BN = 256
 MAX_K_2BYTE = 8192
 
 
-def supports_fused(m: int, k: int, n: int, itemsize: int = 2) -> bool:
+def supports_fused(m: int, k: int, itemsize: int = 2) -> bool:
+    """VMEM gate. N never enters the budget: the kernel streams fixed
+    [K, BN] weight / [BM, BN] output tiles regardless of total N."""
     return k <= MAX_K_2BYTE * 2 // max(itemsize, 2) and m >= 8
 
 

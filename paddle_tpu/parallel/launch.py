@@ -27,17 +27,46 @@ def _free_port() -> int:
 
 def launch(nproc: int, script_argv, coordinator: str = None,
            devices_per_proc: int = None, log_dir: str = None,
-           poll_interval: float = 0.5):
+           poll_interval: float = 0.5, max_restarts: int = 0):
     """Spawn ``nproc`` copies of ``script_argv``; returns exit codes.
 
     Failure handling (reference heart_beat_monitor.h:38 analog for the
     launcher): ranks are monitored while running -- when one dies with a
     nonzero code, the survivors (which would otherwise hang in the next
     collective forever) are terminated and the dead rank's log tail is
-    printed with its rank id. Each rank gets a DISTINCT endpoint
-    (endpoints[0] is the coordinator), matching the reference's launcher
-    contract where user code indexes PADDLE_TRAINER_ENDPOINTS[rank].
+    printed with its rank id.
+
+    ``max_restarts`` > 0 is the elastic-recovery mode (SCOPE.md 5.3: jax
+    cannot resize a live mesh, so elasticity = fast restart): after a
+    failed attempt the WHOLE job is relaunched on fresh ports with
+    ``PADDLE_RESTART_ATTEMPT`` incremented; training scripts resume from
+    their latest checkpoint (utils.Checkpointer.latest()).
+
+    Each rank gets a DISTINCT endpoint (endpoints[0] is the coordinator),
+    matching the reference's launcher contract where user code indexes
+    PADDLE_TRAINER_ENDPOINTS[rank].
     """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    for attempt in range(max_restarts + 1):
+        if attempt > 0 and coordinator:
+            # keep the advertised coordinator HOST, refresh only the port
+            # (the old port may linger in TIME_WAIT)
+            host = coordinator.rsplit(":", 1)[0]
+            coordinator = f"{host}:{_free_port()}"
+        codes = _launch_once(nproc, script_argv, coordinator,
+                             devices_per_proc, log_dir, poll_interval,
+                             attempt)
+        if all(c == 0 for c in codes) or attempt == max_restarts:
+            return codes
+        sys.stderr.write(
+            f"[paddle_tpu.launch] attempt {attempt} failed; restarting the "
+            f"job from the latest checkpoint "
+            f"({attempt + 1}/{max_restarts} restarts used)\n")
+
+
+def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
+                 poll_interval, attempt):
     import time
     if coordinator:
         host, port0 = coordinator.rsplit(":", 1)
@@ -61,12 +90,14 @@ def launch(nproc: int, script_argv, coordinator: str = None,
             "PADDLE_TRAINERS_NUM": str(nproc),
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
             "PADDLE_CURRENT_ENDPOINT": eps[rank],
+            "PADDLE_RESTART_ATTEMPT": str(attempt),
         })
         if devices_per_proc:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 f" --xla_force_host_platform_device_count="
                                 f"{devices_per_proc}").strip()
-        log_path = os.path.join(log_dir, f"rank{rank}.log")
+        log_path = os.path.join(log_dir, f"rank{rank}.log" if attempt == 0
+                                else f"rank{rank}.attempt{attempt}.log")
         logs.append(log_path)
         lf = open(log_path, "wb")
         try:
@@ -112,12 +143,16 @@ def main():
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--devices_per_proc", type=int, default=None)
     ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--max_restarts", type=int, default=0,
+                    help="restart the whole job up to N times on failure "
+                         "(resume from your Checkpointer)")
     ap.add_argument("script", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.script:
         ap.error("no training script given")
     codes = launch(args.nproc, args.script, args.coordinator,
-                   args.devices_per_proc, log_dir=args.log_dir)
+                   args.devices_per_proc, log_dir=args.log_dir,
+                   max_restarts=args.max_restarts)
     # any non-clean rank (nonzero, signal-killed => negative, unreaped =>
     # None) must fail the launch: max() would mask -11 behind a clean 0
     sys.exit(0 if all(c == 0 for c in codes) else 1)

@@ -64,9 +64,9 @@ def test_integer_inputs_are_exact():
 
 def test_gate_rejects_huge_k_tiny_m_and_f32_budget():
     assert not pallas_int8.supports_fused(128, pallas_int8.MAX_K_2BYTE + 1,
-                                          128, itemsize=2)
-    assert not pallas_int8.supports_fused(4, 128, 128)
-    assert pallas_int8.supports_fused(64, 4096, 1024, itemsize=2)
+                                          itemsize=2)
+    assert not pallas_int8.supports_fused(4, 128)
+    assert pallas_int8.supports_fused(64, 4096, itemsize=2)
     # f32 activations halve the K budget (VMEM)
-    assert not pallas_int8.supports_fused(64, 8192, 1024, itemsize=4)
-    assert pallas_int8.supports_fused(64, 4096, 1024, itemsize=4)
+    assert not pallas_int8.supports_fused(64, 8192, itemsize=4)
+    assert pallas_int8.supports_fused(64, 4096, itemsize=4)

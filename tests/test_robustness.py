@@ -119,3 +119,38 @@ def test_monitored_run_failure_accounting():
     assert run2() is None
     with pytest.raises(ValueError):
         run2()
+
+
+def test_launch_elastic_restart(tmp_path):
+    """max_restarts: a rank that crashes on the first attempt is recovered
+    by a whole-job relaunch (fresh ports, PADDLE_RESTART_ATTEMPT bumped) —
+    the restart-from-checkpoint elasticity mode (SCOPE.md 5.3)."""
+    from paddle_tpu.parallel.launch import launch
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "attempt = int(os.environ['PADDLE_RESTART_ATTEMPT'])\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "marker = os.path.join(%r, f'seen_a{attempt}_r{rank}')\n"
+        "open(marker, 'w').close()\n"
+        "if attempt == 0 and rank == 1:\n"
+        "    sys.exit(3)   # simulated hardware failure on first attempt\n"
+        "print('done', attempt, rank)\n" % str(tmp_path))
+    codes = launch(2, [str(script)], log_dir=str(tmp_path / "logs"),
+                   max_restarts=1)
+    assert codes == [0, 0]
+    # both attempts actually ran: attempt 0 crashed, attempt 1 completed
+    assert (tmp_path / "seen_a0_r1").exists()
+    assert (tmp_path / "seen_a1_r0").exists()
+    assert (tmp_path / "seen_a1_r1").exists()
+
+
+def test_launch_elastic_budget_exhausted(tmp_path):
+    """A permanently-failing job stops after max_restarts and reports the
+    failure code instead of looping forever."""
+    from paddle_tpu.parallel.launch import launch
+    script = tmp_path / "dead.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    codes = launch(2, [str(script)], log_dir=str(tmp_path / "logs"),
+                   max_restarts=2)
+    assert any(c == 7 for c in codes)
