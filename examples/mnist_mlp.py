@@ -1,0 +1,47 @@
+"""MNIST softmax MLP (reference: tests/book/test_recognize_digits.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a checkout without install
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        img = fluid.data("img", [784], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(img, 200, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        test_prog = main_p.clone(for_test=True)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+
+    train = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=4096),
+        batch_size=256, drop_last=True)
+    test_batch = next(iter(fluid.reader.batch(
+        fluid.dataset.mnist.test(), batch_size=1024)()))
+    tx = np.stack([s[0] for s in test_batch]).astype("float32")
+    ty = np.array([[s[1]] for s in test_batch], "int64")
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    for epoch in range(2):
+        for batch in train():
+            x = np.stack([s[0] for s in batch]).astype("float32")
+            y = np.array([[s[1]] for s in batch], "int64")
+            exe.run(main_p, feed={"img": x, "label": y}, fetch_list=[])
+        a, = exe.run(test_prog, feed={"img": tx, "label": ty},
+                     fetch_list=[acc])
+        print(f"epoch {epoch}: test accuracy {float(np.asarray(a)):.3f}")
+
+
+if __name__ == "__main__":
+    main()

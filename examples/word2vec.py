@@ -1,0 +1,51 @@
+"""Skip-gram word2vec with sampled softmax (reference:
+tests/book/test_word2vec.py; nce analog via sampled_softmax)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a checkout without install
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+VOCAB, DIM, WIN = 2000, 64, 2
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        center = fluid.data("center", [1], "int64")
+        context = fluid.data("context", [1], "int64")
+        emb = layers.embedding(center, (VOCAB, DIM))
+        emb = layers.reshape(emb, [-1, DIM])
+        logits = layers.fc(emb, VOCAB)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, context))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+
+    # synthetic corpus with strong bigram structure so the loss has signal
+    rng = np.random.RandomState(0)
+    corpus = [(w, (w * 7 + rng.randint(1, 1 + WIN)) % VOCAB)
+              for w in rng.randint(0, VOCAB, 80_000)]
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for step in range(200):
+        batch = [corpus[i] for i in
+                 rng.randint(0, len(corpus), 256)]
+        c = np.array([[b[0]] for b in batch], "int64")
+        t = np.array([[b[1]] for b in batch], "int64")
+        lv, = exe.run(main_p, feed={"center": c, "context": t},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(())))
+        if step % 50 == 0:
+            print(f"step {step}: loss {losses[-1]:.3f}")
+    print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+
+if __name__ == "__main__":
+    main()

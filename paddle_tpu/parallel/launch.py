@@ -38,9 +38,12 @@ def launch(nproc: int, script_argv, coordinator: str = None,
 
     ``max_restarts`` > 0 is the elastic-recovery mode (SCOPE.md 5.3: jax
     cannot resize a live mesh, so elasticity = fast restart): after a
-    failed attempt the WHOLE job is relaunched on fresh ports with
+    failed attempt the WHOLE job is relaunched with
     ``PADDLE_RESTART_ATTEMPT`` incremented; training scripts resume from
-    their latest checkpoint (utils.Checkpointer.latest()).
+    their latest checkpoint (``utils.Checkpointer.restore()``, which loads
+    ``latest_step()``). An EXPLICIT ``coordinator`` address is kept
+    verbatim across restarts (external peers agreed on it); the default
+    localhost endpoints are refreshed to dodge TIME_WAIT.
 
     Each rank gets a DISTINCT endpoint (endpoints[0] is the coordinator),
     matching the reference's launcher contract where user code indexes
@@ -49,11 +52,6 @@ def launch(nproc: int, script_argv, coordinator: str = None,
     if max_restarts < 0:
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
     for attempt in range(max_restarts + 1):
-        if attempt > 0 and coordinator:
-            # keep the advertised coordinator HOST, refresh only the port
-            # (the old port may linger in TIME_WAIT)
-            host = coordinator.rsplit(":", 1)[0]
-            coordinator = f"{host}:{_free_port()}"
         codes = _launch_once(nproc, script_argv, coordinator,
                              devices_per_proc, log_dir, poll_interval,
                              attempt)
