@@ -18,10 +18,10 @@ def main():
                                         hidden=64, n_layers=2, n_heads=4,
                                         ffn_hidden=128, dropout=0.0)
     S = 12
+    B = 32
     main_p, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
         A = dict(append_batch_size=False)
-        B = 32
         src = fluid.data("src", [B, S], "int64", **A)
         spos = fluid.data("spos", [B, S], "int64", **A)
         smask = fluid.data("smask", [B, S], "float32", **A)
@@ -35,14 +35,15 @@ def main():
         fluid.optimizer.Adam(2e-3).minimize(loss)
 
     rng = np.random.RandomState(0)
-    pos = np.tile(np.arange(S, dtype="int64"), (32, 1))
-    ones = np.ones((32, S), "float32")
+    pos = np.tile(np.arange(S, dtype="int64"), (B, 1))
+    ones = np.ones((B, S), "float32")
 
     def make_batch():
         # task: target = source reversed, +1 mod vocab
-        s = rng.randint(2, 118, (32, S)).astype("int64")
+        s = rng.randint(2, 118, (B, S)).astype("int64")
         t = ((s[:, ::-1] + 1) % 120).astype("int64")
-        trg_in = np.concatenate([np.ones((32, 1), "int64"), t[:, :-1]], 1)
+        trg_in = np.concatenate([np.ones((B, 1), "int64"),
+                                 t[:, :-1]], 1)
         return {"src": s, "spos": pos, "smask": ones, "trg": trg_in,
                 "tpos": pos, "tmask": ones, "lbl": t}
 

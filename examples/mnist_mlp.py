@@ -40,7 +40,8 @@ def main():
             exe.run(main_p, feed={"img": x, "label": y}, fetch_list=[])
         a, = exe.run(test_prog, feed={"img": tx, "label": ty},
                      fetch_list=[acc])
-        print(f"epoch {epoch}: test accuracy {float(np.asarray(a)):.3f}")
+        print(f"epoch {epoch}: test accuracy "
+              f"{float(np.asarray(a).reshape(())):.3f}")
 
 
 if __name__ == "__main__":

@@ -1,5 +1,6 @@
-"""Skip-gram word2vec with sampled softmax (reference:
-tests/book/test_word2vec.py; nce analog via sampled_softmax)."""
+"""Skip-gram word2vec (reference: tests/book/test_word2vec.py).
+Full-vocabulary softmax — small vocab; for large vocabs see
+layers.sampled_softmax_with_cross_entropy / layers.nce."""
 import os
 import sys
 

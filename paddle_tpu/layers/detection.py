@@ -13,6 +13,8 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
            "target_assign", "ssd_loss", "sigmoid_focal_loss",
            "detection_output", "density_prior_box", "generate_proposals",
            "generate_proposal_labels", "rpn_target_assign", "yolov3_loss",
+           "collect_fpn_proposals", "distribute_fpn_proposals",
+           "generate_mask_targets",
            "box_decoder_and_assign", "polygon_box_transform",
            "retinanet_detection_output", "multi_box_head"]
 
@@ -588,3 +590,56 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
     blk = helper.main_program.current_block()
     return (blk.var(rois.name), blk.var(labels.name), blk.var(tgt.name),
             blk.var(inw.name), blk.var(outw.name), blk.var(cls_w.name))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """Reference detection.py:collect_fpn_proposals. Fixed-shape outputs:
+    (rois [N, post_nms_top_n, 4], rois_num [N]); zero-score rows are level
+    padding and excluded from the counts."""
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    rois = _out(helper, multi_rois[0].dtype, stop_gradient=True)
+    num = _out(helper, "int64", stop_gradient=True)
+    helper.append_op("collect_fpn_proposals",
+                     inputs={"MultiLevelRois": list(multi_rois),
+                             "MultiLevelScores": list(multi_scores)},
+                     outputs={"FpnRois": [rois], "RoisNum": [num]},
+                     attrs={"post_nms_topN": int(post_nms_top_n)})
+    blk = helper.main_program.current_block()
+    return blk.var(rois.name), blk.var(num.name)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Reference detection.py:distribute_fpn_proposals. Fixed-shape TPU
+    form: returns the per-roi LEVEL INDEX [N, R] int32 instead of ragged
+    per-level tensors + restore index — run the (static) per-level compute
+    and select rows by level (see models/mask_rcnn.py for the pattern)."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    lvl = _out(helper, "int32", stop_gradient=True)
+    helper.append_op("distribute_fpn_proposals",
+                     inputs={"FpnRois": [fpn_rois]},
+                     outputs={"RoisLevel": [lvl]},
+                     attrs={"min_level": int(min_level),
+                            "max_level": int(max_level),
+                            "refer_level": int(refer_level),
+                            "refer_scale": int(refer_scale)})
+    return helper.main_program.current_block().var(lvl.name)
+
+
+def generate_mask_targets(rois, gt_masks, matched_gt, fg_mask, im_shape,
+                          resolution=28, name=None):
+    """Mask-head training targets (reference generate_mask_labels analog):
+    crop each fg roi's matched gt bitmap and resize to resolution^2 {0,1}.
+    rois [N,R,4]; gt_masks [N,G,Hm,Wm]; matched_gt [N,R] int32;
+    fg_mask [N,R]; im_shape (h, w) of the canvas the bitmaps cover."""
+    helper = LayerHelper("generate_mask_targets", name=name)
+    out = _out(helper, "float32", stop_gradient=True)
+    helper.append_op("generate_mask_targets",
+                     inputs={"Rois": [rois], "GtMasks": [gt_masks],
+                             "MatchedGt": [matched_gt], "FgMask": [fg_mask]},
+                     outputs={"MaskTargets": [out]},
+                     attrs={"resolution": int(resolution),
+                            "im_shape": [float(im_shape[0]),
+                                         float(im_shape[1])]})
+    return helper.main_program.current_block().var(out.name)

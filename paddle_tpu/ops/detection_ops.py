@@ -916,3 +916,125 @@ def generate_proposal_labels(ctx, ins):
     names = ["Rois", "LabelsInt32", "ClsWeights", "BboxTargets",
              "BboxInsideWeights", "BboxOutsideWeights"]
     return {n: [o] for n, o in zip(names, outs)}
+
+
+@register("distribute_fpn_proposals", grad=None,
+          nondiff_inputs=("FpnRois",))
+def distribute_fpn_proposals(ctx, ins):
+    """FPN level assignment (detection/distribute_fpn_proposals_op.cc):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)), clamped to
+    [min_level, max_level].
+
+    Fixed-shape TPU form: instead of the reference's per-level ragged
+    outputs + restore index, emit the per-roi level index [N, R] int32;
+    consumers run the (static) per-level compute and select by level —
+    shape-stable and gather-free (see models/mask_rcnn.py).
+    Zero-area padding rois get min_level (they are masked downstream).
+    """
+    jnp = _jnp()
+    rois = ins["FpnRois"][0]
+    min_l = int(ctx.attr("min_level", 2))
+    max_l = int(ctx.attr("max_level", 5))
+    refer_l = int(ctx.attr("refer_level", 4))
+    refer_s = float(ctx.attr("refer_scale", 224))
+    w = jnp.maximum(rois[..., 2] - rois[..., 0], 0.0)
+    h = jnp.maximum(rois[..., 3] - rois[..., 1], 0.0)
+    scale = jnp.sqrt(w * h)
+    # zero-area padding rois: log2(1e-6/refer_s) lands far below min_level,
+    # so the clip routes them to min_level
+    lvl = jnp.floor(refer_l + jnp.log2(jnp.maximum(scale, 1e-6) / refer_s))
+    lvl = jnp.clip(lvl, min_l, max_l).astype("int32")
+    return {"RoisLevel": [lvl]}
+
+
+@register("generate_mask_targets", grad=None,
+          nondiff_inputs=("Rois", "GtMasks", "MatchedGt", "FgMask"))
+def generate_mask_targets(ctx, ins):
+    """Mask-head training targets (detection/ mask variant of
+    generate_proposal_labels; reference generate_mask_labels_op.cc): crop
+    each fg roi's matched gt bitmap mask and resize to resolution x
+    resolution with bilinear sampling, thresholded to {0,1}.
+
+    Rois [N, R, 4] (image coords); GtMasks [N, G, Hm, Wm] float/uint8
+    bitmaps covering the image canvas [0, H) x [0, W) given by attr
+    im_shape (h, w); MatchedGt [N, R] int32; FgMask [N, R] (0/1).
+    Out: MaskTargets [N, R, res, res] float32 (zeros for non-fg rows).
+    """
+    import jax
+    jnp = _jnp()
+    rois = ins["Rois"][0]
+    masks = ins["GtMasks"][0].astype(jnp.float32)
+    matched = ins["MatchedGt"][0].astype("int32")
+    fg = ins["FgMask"][0]
+    res = int(ctx.attr("resolution", 28))
+    im_h, im_w = [float(v) for v in ctx.attr("im_shape", [0, 0])]
+    N, R = rois.shape[0], rois.shape[1]
+    Hm, Wm = masks.shape[2], masks.shape[3]
+
+    def per_image(rois_i, masks_i, matched_i, fg_i):
+        sel = masks_i[matched_i]                       # [R, Hm, Wm]
+        x1, y1, x2, y2 = (rois_i[:, 0], rois_i[:, 1],
+                          rois_i[:, 2], rois_i[:, 3])
+        # sample a res x res grid inside each roi, in mask-pixel coords
+        # (the gt bitmap spans the image canvas)
+        t = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
+        gx = (x1[:, None] + t[None, :] * jnp.maximum(x2 - x1, 1e-6)[:, None]
+              ) * (Wm / max(im_w, 1e-6))
+        gy = (y1[:, None] + t[None, :] * jnp.maximum(y2 - y1, 1e-6)[:, None]
+              ) * (Hm / max(im_h, 1e-6))
+
+        def bilinear(m, ys, xs):
+            y0 = jnp.clip(jnp.floor(ys).astype("int32"), 0, Hm - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype("int32"), 0, Wm - 1)
+            y1i = jnp.clip(y0 + 1, 0, Hm - 1)
+            x1i = jnp.clip(x0 + 1, 0, Wm - 1)
+            wy = jnp.clip(ys - y0, 0.0, 1.0)
+            wx = jnp.clip(xs - x0, 0.0, 1.0)
+            yy0, yy1 = y0[:, None], y1i[:, None]
+            xx0, xx1 = x0[None, :], x1i[None, :]
+            v00 = m[yy0, xx0]
+            v01 = m[yy0, xx1]
+            v10 = m[yy1, xx0]
+            v11 = m[yy1, xx1]
+            wyc = wy[:, None]
+            wxc = wx[None, :]
+            return (v00 * (1 - wyc) * (1 - wxc) + v01 * (1 - wyc) * wxc +
+                    v10 * wyc * (1 - wxc) + v11 * wyc * wxc)
+
+        out = jax.vmap(bilinear)(sel, gy - 0.5, gx - 0.5)   # [R, res, res]
+        out = (out >= 0.5).astype(jnp.float32)
+        return out * fg_i.astype(jnp.float32)[:, None, None]
+
+    out = jax.vmap(per_image)(rois.astype(jnp.float32), masks, matched, fg)
+    return {"MaskTargets": [out]}
+
+
+@register("collect_fpn_proposals", grad=None,
+          nondiff_inputs=("MultiLevelRois", "MultiLevelScores"))
+def collect_fpn_proposals(ctx, ins):
+    """Collect per-level RPN proposals into one ranked set
+    (detection/collect_fpn_proposals_op.cc): concat all levels, keep the
+    post_nms_topN highest-scoring per image.
+
+    MultiLevelRois: list of [N, Ri, 4]; MultiLevelScores: list of
+    [N, Ri, 1] (zero score marks level padding rows). Outputs
+    FpnRois [N, post_nms_topN, 4] + RoisNum [N] valid counts.
+    """
+    import jax
+    jnp = _jnp()
+    rois = jnp.concatenate([r for r in ins["MultiLevelRois"]], axis=1)
+    scores = jnp.concatenate([s for s in ins["MultiLevelScores"]],
+                             axis=1)[..., 0]
+    post_n = int(ctx.attr("post_nms_topN", 1000))
+    k = min(post_n, rois.shape[1])
+
+    def per_image(r, s):
+        top_s, idx = jax.lax.top_k(s, k)
+        out = r[idx]
+        if k < post_n:
+            out = jnp.pad(out, ((0, post_n - k), (0, 0)))
+            top_s = jnp.pad(top_s, (0, post_n - k))
+        return out, jnp.sum(top_s > 0).astype("int64")
+
+    out, num = jax.vmap(per_image)(rois.astype(jnp.float32), scores)
+    return {"FpnRois": [out], "RoisNum": [num]}

@@ -1,0 +1,147 @@
+"""Mask R-CNN + FPN family: the new collect/distribute/mask-target ops and
+the full model. Tiny configs keep CPU times sane."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import mask_rcnn
+
+A = dict(append_batch_size=False)
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feeds, fetch_list=fetches)
+
+
+def test_distribute_fpn_proposals_levels():
+    rois_np = np.array([[[0, 0, 16, 16],       # tiny -> min level
+                         [0, 0, 56, 56],       # refer_scale at refer level
+                         [0, 0, 300, 300],     # huge -> max level
+                         [0, 0, 0, 0]]],       # padding -> min level
+                       np.float32)
+
+    def build():
+        rois = fluid.data("rois", [1, 4, 4], "float32", **A)
+        return [layers.distribute_fpn_proposals(rois, 2, 4, refer_level=4,
+                                                refer_scale=56)]
+
+    lvl, = _run(build, {"rois": rois_np})
+    assert lvl.tolist() == [[2, 4, 4, 2]]
+
+
+def test_collect_fpn_proposals_topk():
+    r1 = np.zeros((1, 3, 4), np.float32)
+    r1[0, :, 2:] = [[10, 10], [20, 20], [30, 30]]
+    s1 = np.array([[[0.9], [0.2], [0.0]]], np.float32)   # last = padding
+    r2 = np.zeros((1, 2, 4), np.float32)
+    r2[0, :, 2:] = [[40, 40], [50, 50]]
+    s2 = np.array([[[0.5], [0.7]]], np.float32)
+
+    def build():
+        a = fluid.data("r1", [1, 3, 4], "float32", **A)
+        b = fluid.data("r2", [1, 2, 4], "float32", **A)
+        sa = fluid.data("s1", [1, 3, 1], "float32", **A)
+        sb = fluid.data("s2", [1, 2, 1], "float32", **A)
+        rois, num = layers.collect_fpn_proposals([a, b], [sa, sb], 2, 3,
+                                                 post_nms_top_n=4)
+        return [rois, num]
+
+    rois, num = _run(build, {"r1": r1, "r2": r2, "s1": s1, "s2": s2})
+    assert int(num[0]) == 4            # 4 real rows above zero score
+    # ranked by score: 0.9 (10), 0.7 (50), 0.5 (40), 0.2 (20)
+    assert rois[0, :, 2].astype(int).tolist() == [10, 50, 40, 20]
+
+
+def test_generate_mask_targets_crop():
+    # gt mask: left half of the canvas is 1
+    masks = np.zeros((1, 1, 32, 32), np.float32)
+    masks[0, 0, :, :16] = 1.0
+    rois_np = np.array([[[0, 0, 32, 32],      # whole canvas: half-on target
+                         [0, 0, 16, 32]]],    # left half: fully-on target
+                       np.float32)
+
+    def build():
+        rois = fluid.data("rois", [1, 2, 4], "float32", **A)
+        gtm = fluid.data("gtm", [1, 1, 32, 32], "float32", **A)
+        match = fluid.data("match", [1, 2], "int32", **A)
+        fg = fluid.data("fg", [1, 2], "float32", **A)
+        return [layers.generate_mask_targets(rois, gtm, match, fg, (32, 32),
+                                             resolution=8)]
+
+    t, = _run(build, {"rois": rois_np, "gtm": masks,
+                      "match": np.zeros((1, 2), np.int32),
+                      "fg": np.ones((1, 2), np.float32)})
+    assert t.shape == (1, 2, 8, 8)
+    # roi 0 covers the canvas: left half of the target is 1
+    np.testing.assert_array_equal(t[0, 0, :, :4], 1.0)
+    np.testing.assert_array_equal(t[0, 0, :, 5:], 0.0)
+    # roi 1 covers exactly the mask: all ones
+    np.testing.assert_array_equal(t[0, 1], 1.0)
+
+
+TINY = dict(scale=0.1, levels=2, num_classes=4, post_nms_top_n=12,
+            roi_resolution=4, mask_resolution=4)
+
+
+def test_mask_rcnn_trains():
+    N, G = 1, 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [N, 3, 64, 64], "float32", **A)
+        gt_box = fluid.data("gt_box", [N, G, 4], "float32", **A)
+        gt_label = fluid.data("gt_label", [N, G], "int32", **A)
+        gt_masks = fluid.data("gt_masks", [N, G, 32, 32], "float32", **A)
+        im_info = fluid.data("im_info", [N, 3], "float32", **A)
+        total, rpn_l, box_l, mask_l = mask_rcnn.mask_rcnn(
+            img, gt_box, gt_label, gt_masks, im_info, batch_size=N, **TINY)
+        fluid.optimizer.Adam(1e-3).minimize(total)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    boxes = np.array([[[4, 4, 28, 28], [32, 36, 60, 58]]], np.float32)
+    masks = np.zeros((N, G, 32, 32), np.float32)
+    masks[0, 0, 2:14, 2:14] = 1
+    masks[0, 1, 18:28, 16:30] = 1
+    feeds = {"img": rng.uniform(0, 1, (N, 3, 64, 64)).astype(np.float32),
+             "gt_box": boxes,
+             "gt_label": np.array([[1, 3]], np.int32),
+             "gt_masks": masks,
+             "im_info": np.array([[64, 64, 1.0]], np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(
+                      exe.run(main, feed=feeds, fetch_list=[total])[0])
+                      .reshape(())) for _ in range(6)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_mask_rcnn_infer_shapes():
+    N = 1
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [N, 3, 64, 64], "float32", **A)
+        im_info = fluid.data("im_info", [N, 3], "float32", **A)
+        dets, nums, masks = mask_rcnn.mask_rcnn_infer(
+            img, im_info, batch_size=N, keep_top_k=10, **TINY)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        d, n, m = exe.run(
+            main,
+            feed={"img": rng.uniform(0, 1, (N, 3, 64, 64)).astype(np.float32),
+                  "im_info": np.array([[64, 64, 1.0]], np.float32)},
+            fetch_list=[dets, nums, masks])
+    assert d.shape == (N, 10, 6)
+    assert m.shape == (N, 10, 8, 8)
+    assert np.isfinite(m).all() and (m >= 0).all() and (m <= 1).all()
+    k = int(n[0])
+    assert (d[0, k:, 0] == -1).all()
