@@ -1,0 +1,65 @@
+"""Object detection (reference: PaddleCV detection configs): train a tiny
+YOLOv3 for a few steps, then serve it through save_inference_model ->
+Predictor and print NMS'd detections."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a checkout without install
+
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import yolov3
+
+TINY = dict(scale=0.25, stage_blocks=(1, 1, 1, 1, 1), num_classes=4)
+
+
+def main():
+    # ---- train a few steps ----------------------------------------------
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        img = fluid.data("img", [3, 64, 64], "float32")
+        gt_box = fluid.data("gt_box", [6, 4], "float32")
+        gt_label = fluid.data("gt_label", [6], "int32")
+        loss = yolov3.yolov3(img, gt_box, gt_label, **TINY)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    boxes = np.zeros((4, 6, 4), np.float32)
+    boxes[:, :2, :2] = rng.uniform(0.3, 0.6, (4, 2, 2))
+    boxes[:, :2, 2:] = rng.uniform(0.1, 0.25, (4, 2, 2))
+    feed = {"img": rng.uniform(0, 1, (4, 3, 64, 64)).astype(np.float32),
+            "gt_box": boxes,
+            "gt_label": rng.randint(0, 4, (4, 6)).astype(np.int32)}
+    exe = fluid.Executor()
+    exe.run(startup)
+    for step in range(5):
+        lv, = exe.run(main_p, feed=feed, fetch_list=[loss])
+        print(f"step {step}: loss {float(np.asarray(lv).reshape(())):.3f}")
+
+    # ---- export + serve --------------------------------------------------
+    infer_p, infer_start = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(infer_p, infer_start):
+        img = fluid.data("img", [3, 64, 64], "float32")
+        img_size = fluid.data("img_size", [2], "int32")
+        dets, nums = yolov3.yolov3_infer(img, img_size, keep_top_k=10, **TINY)
+    with tempfile.TemporaryDirectory() as d:
+        # serve with the TRAINED weights (shared default scope)
+        fluid.io.save_inference_model(d, ["img", "img_size"], [dets, nums],
+                                      exe, main_program=infer_p)
+        from paddle_tpu.inference import Predictor
+        pred = Predictor(d)
+        out, counts = pred.run(
+            {"img": feed["img"][:1],
+             "img_size": np.full((1, 2), 64, np.int32)})
+    k = int(counts[0])
+    print(f"served {k} detections; first rows (label, score, box):")
+    for row in out[0, :min(k, 3)]:
+        print("  ", np.round(row, 2))
+
+
+if __name__ == "__main__":
+    main()
