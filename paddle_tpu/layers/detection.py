@@ -14,7 +14,7 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
            "detection_output", "density_prior_box", "generate_proposals",
            "generate_proposal_labels", "rpn_target_assign", "yolov3_loss",
            "collect_fpn_proposals", "distribute_fpn_proposals",
-           "generate_mask_targets",
+           "generate_mask_targets", "retinanet_target_assign",
            "box_decoder_and_assign", "polygon_box_transform",
            "retinanet_detection_output", "multi_box_head"]
 
@@ -643,3 +643,54 @@ def generate_mask_targets(rois, gt_masks, matched_gt, fg_mask, im_shape,
                             "im_shape": [float(im_shape[0]),
                                          float(im_shape[1])]})
     return helper.main_program.current_block().var(out.name)
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4, name=None):
+    """Reference detection.py:retinanet_target_assign. Fixed-shape form
+    (all anchors kept, +/-1/0 labels instead of sampling): returns
+    (score_pred [M, C], loc_pred [M, 4], score_target [M, 1] int32,
+    loc_target [M, 4], bbox_inside_weight [M, 4], fg_num [1]).
+
+    Ignore rows (-1) have their logits zero-masked (zero GRADIENT through
+    the focal loss) and their labels forced to 0; the resulting constant
+    bg-at-sigmoid(0) term has no parameter gradient — the shape-stable
+    equivalent of the reference's sampled gather.
+    """
+    from . import nn as _nn
+    from . import tensor as _tensor
+    from .control_flow import equal, greater_than
+    from .extras import logical_not
+    helper = LayerHelper("retinanet_target_assign", name=name)
+    labels = _out(helper, "int32", stop_gradient=True)
+    matched = _out(helper, "int32", stop_gradient=True)
+    tgt = _out(helper, anchor_box.dtype, stop_gradient=True)
+    fg_num = _out(helper, "int32", stop_gradient=True)
+    inputs = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+              "GtLabels": [gt_labels]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    helper.append_op("retinanet_target_assign", inputs=inputs,
+                     outputs={"Labels": [labels], "MatchedGt": [matched],
+                              "BboxTargets": [tgt], "FgNum": [fg_num]},
+                     attrs={"positive_overlap": float(positive_overlap),
+                            "negative_overlap": float(negative_overlap)})
+    blk = helper.main_program.current_block()
+    labels, tgt = blk.var(labels.name), blk.var(tgt.name)
+    minus1 = _tensor.fill_constant([1], "int32", -1)
+    valid = _tensor.cast(logical_not(equal(labels, minus1)), "float32")
+    valid_col = _nn.reshape(valid, [-1, 1])
+    score_pred = _nn.elementwise_mul(cls_logits, valid_col)
+    score_target = _nn.reshape(
+        _tensor.cast(_nn.elementwise_mul(
+            _tensor.cast(labels, "float32"), valid), "int32"), [-1, 1])
+    pos = _tensor.cast(
+        greater_than(labels, _tensor.fill_constant([1], "int32", 0)),
+        "float32")
+    inside_w = _nn.expand(_nn.reshape(pos, [-1, 1]), [1, 4])
+    return (score_pred, bbox_pred, score_target, tgt, inside_w,
+            blk.var(fg_num.name))

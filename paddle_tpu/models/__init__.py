@@ -9,3 +9,4 @@ from . import vgg        # noqa: F401
 from . import yolov3     # noqa: F401
 from . import faster_rcnn  # noqa: F401
 from . import mask_rcnn   # noqa: F401
+from . import retinanet   # noqa: F401

@@ -1038,3 +1038,61 @@ def collect_fpn_proposals(ctx, ins):
 
     out, num = jax.vmap(per_image)(rois.astype(jnp.float32), scores)
     return {"FpnRois": [out], "RoisNum": [num]}
+
+
+@register("retinanet_target_assign", grad=None,
+          nondiff_inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd",
+                          "ImInfo"))
+def retinanet_target_assign(ctx, ins):
+    """RetinaNet anchor labeling (detection/retinanet_target_assign_op.cc):
+    like rpn_target_assign but class-aware — fg anchors (IoU >=
+    positive_overlap, plus the best anchor per gt) take their matched gt's
+    CLASS label (1..C-1), bg anchors (IoU < negative_overlap) take 0, the
+    rest are ignored (-1). Same fixed-shape deviation as rpn_target_assign:
+    all anchors kept, reference sampling becomes downstream weighting.
+
+    Anchor [M, 4]; GtBoxes [G, 4] (zero-area rows = padding); GtLabels [G].
+    Outputs: Labels [M] int32, MatchedGt [M], BboxTargets [M, 4] (raw
+    deltas, gt_norm=0 to pair with the box_coder/proposals decode), FgNum
+    [1] int32.
+    """
+    jnp = _jnp()
+    anchors = ins["Anchor"][0]
+    gt = ins["GtBoxes"][0]
+    gt_labels = ins["GtLabels"][0].astype("int32").reshape(-1)
+    is_crowd = ins.get("IsCrowd", [None])[0]
+    im_info = ins.get("ImInfo", [None])[0]
+    pos_ov = float(ctx.attr("positive_overlap", 0.5))
+    neg_ov = float(ctx.attr("negative_overlap", 0.4))
+    nonzero_gt = ((gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1]) > 0)
+    iou_all = jnp.where(nonzero_gt[:, None], _iou_matrix(gt, anchors), 0.0)
+    if is_crowd is not None:
+        # crowd gts never match as positives; anchors over a crowd region
+        # are IGNORED, not background (rpn_target_assign parity)
+        crowd = (is_crowd.reshape(-1) != 0) & nonzero_gt
+        iou = jnp.where(crowd[:, None], 0.0, iou_all)
+        crowd_ov = jnp.max(jnp.where(crowd[:, None], iou_all, 0.0), axis=0)
+    else:
+        iou = iou_all
+        crowd_ov = jnp.zeros((anchors.shape[0],), jnp.float32)
+    best_per_anchor = jnp.max(iou, axis=0)
+    arg_gt = jnp.argmax(iou, axis=0).astype("int32")
+    best_per_gt = jnp.max(iou, axis=1, keepdims=True)
+    is_best = jnp.any((iou >= best_per_gt) & (best_per_gt > 0), axis=0)
+    pos = (best_per_anchor >= pos_ov) | is_best
+    neg = (best_per_anchor < neg_ov) & ~pos
+    labels = jnp.where(pos, gt_labels[arg_gt],
+                       jnp.where(neg, 0, -1)).astype("int32")
+    labels = jnp.where((crowd_ov >= neg_ov) & ~pos, -1, labels)
+    if im_info is not None:
+        # anchors straddling the image are ignored (rpn parity, straddle 0)
+        h, w = im_info[0, 0], im_info[0, 1]
+        inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0) &
+                  (anchors[:, 2] < w) & (anchors[:, 3] < h))
+        labels = jnp.where(inside, labels, -1)
+        pos = pos & inside
+    tgt = _encode_deltas(jnp, anchors, gt[arg_gt], gt_norm=0.0)
+    tgt = jnp.where(pos[:, None], tgt, 0.0)
+    fg_num = jnp.maximum(jnp.sum(pos), 1).astype("int32").reshape(1)
+    return {"Labels": [labels], "MatchedGt": [arg_gt],
+            "BboxTargets": [tgt], "FgNum": [fg_num]}

@@ -1,0 +1,149 @@
+"""RetinaNet one-stage family: retinanet_target_assign op semantics and the
+full FPN model (train + infer)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import retinanet
+
+A = dict(append_batch_size=False)
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feeds, fetch_list=fetches)
+
+
+def test_retinanet_target_assign_semantics():
+    anchors_np = np.array([[0, 0, 10, 10],     # IoU 1 with gt0 -> fg cls 2
+                           [0, 0, 9, 9],       # IoU .81 -> fg cls 2
+                           [20, 20, 30, 30],   # IoU 1 with gt1 -> fg cls 5
+                           [50, 50, 60, 60],   # no overlap -> bg (0)
+                           [0, 0, 12, 8]],     # IoU ~.67 -> fg (>=0.5)
+                          np.float32)
+    gt_np = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    lbl_np = np.array([2, 5], np.int32)
+
+    def build():
+        an = fluid.data("an", [5, 4], "float32", **A)
+        gt = fluid.data("gt", [2, 4], "float32", **A)
+        lbl = fluid.data("lbl", [2], "int32", **A)
+        cls_logits = fluid.data("cl", [5, 7], "float32", **A)
+        box_pred = fluid.data("bp", [5, 4], "float32", **A)
+        var = layers.assign(np.ones((5, 4), np.float32))
+        sp, lp, st, lt, iw, fg = layers.retinanet_target_assign(
+            box_pred, cls_logits, an, var, gt, lbl, num_classes=8)
+        return [st, lt, iw, fg]
+
+    st, lt, iw, fg = _run(build, {
+        "an": anchors_np, "gt": gt_np, "lbl": lbl_np,
+        "cl": np.zeros((5, 7), np.float32),
+        "bp": np.zeros((5, 4), np.float32)})
+    assert st.ravel().tolist() == [2, 2, 5, 0, 2]
+    assert int(fg[0]) == 4
+    # inside weights mark exactly the fg rows
+    np.testing.assert_array_equal((iw.sum(1) > 0), st.ravel() > 0)
+    # perfect-match anchors encode zero deltas
+    assert np.abs(lt[0]).max() < 1e-5 and np.abs(lt[2]).max() < 1e-5
+
+
+TINY = dict(scale=0.1, levels=2, num_classes=5, n_convs=1)
+
+
+def test_retinanet_trains():
+    N, G = 1, 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [N, 3, 64, 64], "float32", **A)
+        gt_box = fluid.data("gt_box", [N, G, 4], "float32", **A)
+        gt_label = fluid.data("gt_label", [N, G], "int32", **A)
+        im_info = fluid.data("im_info", [N, 3], "float32", **A)
+        total, cls_l, reg_l = retinanet.retinanet(
+            img, gt_box, gt_label, im_info, batch_size=N, **TINY)
+        fluid.optimizer.Adam(1e-3).minimize(total)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feeds = {"img": rng.uniform(0, 1, (N, 3, 64, 64)).astype(np.float32),
+             "gt_box": np.array([[[8, 8, 40, 40], [30, 20, 62, 60]]],
+                                np.float32),
+             "gt_label": np.array([[1, 3]], np.int32),
+             "im_info": np.array([[64, 64, 1.0]], np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(
+                      exe.run(main, feed=feeds, fetch_list=[total])[0])
+                      .reshape(())) for _ in range(6)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_retinanet_infer_shapes():
+    N = 1
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [N, 3, 64, 64], "float32", **A)
+        im_info = fluid.data("im_info", [N, 3], "float32", **A)
+        dets = retinanet.retinanet_infer(img, im_info, batch_size=N,
+                                         keep_top_k=20, **TINY)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(
+            main,
+            feed={"img": rng.uniform(0, 1, (N, 3, 64, 64)).astype(np.float32),
+                  "im_info": np.array([[64, 64, 1.0]], np.float32)},
+            fetch_list=[dets])
+    assert out.shape == (N, 20, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    if len(kept):
+        assert (kept[:, 2:] >= 0).all() and (kept[:, 2:] <= 64).all()
+
+
+def test_retinanet_crowd_and_straddle_ignored():
+    """Crowd-region anchors and image-straddling anchors must be IGNORED
+    (-1), never background (regression: focal loss would train a real
+    crowd object as bg)."""
+    anchors_np = np.array([[0, 0, 10, 10],      # on the crowd gt -> ignore
+                           [20, 20, 30, 30],    # on the normal gt -> fg
+                           [58, 58, 70, 70],    # straddles image -> ignore
+                           [40, 40, 50, 50]],   # clean bg
+                          np.float32)
+    gt_np = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    lbl_np = np.array([2, 5], np.int32)
+
+    def build():
+        an = fluid.data("an", [4, 4], "float32", **A)
+        gt = fluid.data("gt", [2, 4], "float32", **A)
+        lbl = fluid.data("lbl", [2], "int32", **A)
+        crowd = fluid.data("crowd", [2], "int32", **A)
+        im = fluid.data("im", [1, 3], "float32", **A)
+        cls_logits = fluid.data("cl", [4, 7], "float32", **A)
+        box_pred = fluid.data("bp", [4, 4], "float32", **A)
+        var = layers.assign(np.ones((4, 4), np.float32))
+        sp, lp, st, lt, iw, fg = layers.retinanet_target_assign(
+            box_pred, cls_logits, an, var, gt, lbl, is_crowd=crowd,
+            im_info=im, num_classes=8)
+        return [st, fg, sp]
+
+    st, fg, sp = _run(build, {
+        "an": anchors_np, "gt": gt_np, "lbl": lbl_np,
+        "crowd": np.array([1, 0], np.int32),
+        "im": np.array([[64, 64, 1.0]], np.float32),
+        "cl": np.ones((4, 7), np.float32),
+        "bp": np.zeros((4, 4), np.float32)})
+    # layer maps ignore (-1) -> label 0 with zero-masked logits; the OP-level
+    # distinction shows through sp: ignored rows have logits zeroed
+    assert st.ravel().tolist() == [0, 5, 0, 0]
+    assert int(fg[0]) == 1
+    np.testing.assert_array_equal(sp[0], 0.0)   # crowd anchor masked
+    np.testing.assert_array_equal(sp[2], 0.0)   # straddling anchor masked
+    np.testing.assert_array_equal(sp[1], 1.0)   # fg anchor kept
+    np.testing.assert_array_equal(sp[3], 1.0)   # bg anchor kept
