@@ -19,6 +19,13 @@ Method notes:
     each round -- larger regresses): measured MFU
     rises ~5 points over the V100-era batch sizes and vs_baseline compares
     throughput, which is the per-chip claim BASELINE.md makes.
+  - ResNet runs the TPU-preferred formulation: NHWC (channels-last) layout and
+    a 2x2 space-to-depth stem (the MLPerf factorization of the 7x7/s2 conv;
+    see models/resnet.py). Round-4 finding: a hand-written pure-JAX ResNet-50
+    with the stock formulation measures the same MFU as the framework path
+    (0.318 vs 0.317) -- the framework's whole-program jit adds no overhead;
+    the remaining gap to peak is the HBM roofline of train-mode batch-norm
+    and the residual elementwise passes under vanilla XLA on this chip.
   - feeds are pre-staged on device; this measures the compiled train-step (the
     input pipeline is exercised by tests/test_io_reader.py, not here).
   - The axon relay's block_until_ready does NOT synchronize reliably (round-3
@@ -68,7 +75,8 @@ def _peak():
     return device_peak_flops(kind), kind
 
 
-def bench_resnet50(batch=128, image=224, dtype="bfloat16"):
+def bench_resnet50(batch=128, image=224, dtype="bfloat16", data_format="NHWC",
+                   conv1_space_to_depth=True):
     import jax
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
@@ -78,15 +86,20 @@ def bench_resnet50(batch=128, image=224, dtype="bfloat16"):
     main.random_seed = 0
     startup.random_seed = 0
     with fluid.unique_name.guard(), fluid.program_guard(main, startup):
-        img = fluid.data("img", [3, image, image], dtype)
+        ishape = [3, image, image] if data_format == "NCHW" else [image, image, 3]
+        img = fluid.data("img", ishape, dtype)
         label = fluid.data("label", [1], "int64")
-        loss, acc, _ = resnet.resnet50(img, label, num_classes=1000)
+        loss, acc, _ = resnet.resnet50(img, label, num_classes=1000,
+                                       data_format=data_format,
+                                       conv1_space_to_depth=conv1_space_to_depth)
         fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
 
     rng = np.random.RandomState(0)
+    img_np = rng.randn(batch, 3, image, image).astype(np.float32)
+    if data_format == "NHWC":
+        img_np = np.ascontiguousarray(img_np.transpose(0, 2, 3, 1))
     feed = {
-        "img": jax.device_put(jax.numpy.asarray(
-            rng.randn(batch, 3, image, image).astype(np.float32), dtype=dtype)),
+        "img": jax.device_put(jax.numpy.asarray(img_np, dtype=dtype)),
         "label": jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int32)),
     }
 

@@ -94,6 +94,11 @@ def scope_guard(scope: Scope):
 # --------------------------------------------------------------------------------------
 
 
+def _xla_options():
+    from .. import flags as _flags
+    return _flags.xla_compiler_options()
+
+
 def _as_device_array(x, dtype=None):
     import jax.numpy as jnp
     if hasattr(x, "dtype") and dtype is None:
@@ -249,7 +254,13 @@ class Executor:
         feed_sig = tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)
                                  if not hasattr(v, "dtype") else str(v.dtype))
                                 for k, v in feed.items()))
-        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+        # random_seed is baked into the compiled step (the per-run key is derived
+        # on device from the run counter: rng = fold_in(PRNGKey(seed), counter),
+        # avoiding a per-step host->device key transfer that stalls dispatch).
+        seed = program.random_seed if program.random_seed is not None else 0
+        from .. import flags as _flagsmod
+        key = (id(program), program._version, feed_sig, tuple(fetch_names), seed,
+               _flagsmod.get_flag("xla_compiler_options"),
                compiled_wrapper.strategy_signature()
                if compiled_wrapper is not None else ())
         compiled = self._cache.get(key)
@@ -305,11 +316,13 @@ class Executor:
             feed_vals = {k: _as_device_array(v) for k, v in feed.items()}
         # The PRNG key for run k of a program is fold_in(PRNGKey(seed), k); the
         # counter lives on the Program so results are deterministic per program
-        # regardless of what else ran (matters for seeded init).
-        seed = program.random_seed if program.random_seed is not None else 0
+        # regardless of what else ran (matters for seeded init). Only the raw
+        # u32 counter crosses to the device; fold_in runs inside the compiled
+        # step (an eagerly computed key is a separate tiny dispatch through the
+        # runtime per step, measured at +8ms/step through the axon relay).
         counter = getattr(program, "_rng_run_counter", 0)
         program._rng_run_counter = counter + 1
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+        rng = np.uint32(counter)
 
         from .. import flags as _flags
         from .. import profiler as _profiler
@@ -442,7 +455,11 @@ class Executor:
         gmesh = (wrapper.mesh if wrapper is not None and
                  wrapper.dist_strategy is not None else None)
 
-        def step(mut_state, ro_state, feed, rng):
+        seed = program.random_seed if program.random_seed is not None else 0
+
+        def step(mut_state, ro_state, feed, rng_counter):
+            import jax as _jax
+            rng = _jax.random.fold_in(_jax.random.PRNGKey(seed), rng_counter)
             env: Dict[str, Any] = {}
             env.update(mut_state)
             env.update(ro_state)
@@ -496,15 +513,22 @@ class Executor:
                 [NamedSharding(mesh, P())] * len(fetch_names),
                 state_sharding(state_out),
             )
+            jit_kw = {}
+            if _xla_options():
+                jit_kw["compiler_options"] = _xla_options()
             jitted = jax.jit(step, donate_argnums=(0,),
                              in_shardings=in_shardings,
-                             out_shardings=out_shardings)
+                             out_shardings=out_shardings, **jit_kw)
             state_sh = dict(in_shardings[0])
             state_sh.update(in_shardings[1])
             return _CompiledStep(jitted, (mut_names, ro_names), state_out,
                                  fetch_names, state_shardings=state_sh,
                                  feed_shardings=in_shardings[2])
-        jitted = jax.jit(step, donate_argnums=(0,))
+        jit_kw = {}
+        if _xla_options():
+            # only passed when set: the kwarg needs jax >= 0.4.31
+            jit_kw["compiler_options"] = _xla_options()
+        jitted = jax.jit(step, donate_argnums=(0,), **jit_kw)
         return _CompiledStep(jitted, (mut_names, ro_names), state_out, fetch_names)
 
 

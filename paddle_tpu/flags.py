@@ -87,6 +87,23 @@ define_flag("executor_cache_capacity", 64, int,
             "LRU capacity of the executor compile cache")
 define_flag("profile_executor", False, bool,
             "record per-run wall time in profiler aggregate table")
+define_flag("xla_compiler_options", "", str,
+            "extra XLA backend options for executor-compiled steps, "
+            "comma-separated k=v (e.g. 'xla_tpu_scoped_vmem_limit_kib=65536'); "
+            "the analog of the reference's pass-through gflags for cuDNN/cuBLAS "
+            "tuning knobs")
+
+
+def xla_compiler_options() -> Optional[Dict[str, str]]:
+    raw = get_flag("xla_compiler_options").strip()
+    if not raw:
+        return None
+    out = {}
+    for kv in raw.split(","):
+        k, _, v = kv.partition("=")
+        if k.strip():
+            out[k.strip()] = v.strip()
+    return out or None
 
 # -- accepted no-ops (CUDA-era knobs kept so ported scripts run unchanged) -------------
 for _name, _default in [

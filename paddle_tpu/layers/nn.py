@@ -90,10 +90,12 @@ def one_hot(input, depth, allow_out_of_range=False):
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, use_cudnn=True, act=None,
            name=None, data_format="NCHW"):
-    """Reference nn.py:2543 (use_cudnn accepted and ignored: XLA targets the MXU)."""
+    """Reference nn.py:2543 (use_cudnn accepted and ignored: XLA targets the MXU).
+    data_format='NHWC' runs the channels-last TPU-preferred layout; the Filter
+    parameter stays [O, I/g, kh, kw] in both layouts (checkpoint-compatible)."""
     helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
                          act=act, name=name)
-    c_in = input.shape[1]
+    c_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     fh, fw = (filter_size if isinstance(filter_size, (list, tuple))
               else (filter_size, filter_size))
     groups = groups or 1
@@ -110,14 +112,16 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                else [padding, padding],
                "dilations": list(dilation) if isinstance(dilation, (list, tuple))
                else [dilation, dilation],
-               "groups": groups})
+               "groups": groups,
+               "data_format": data_format})
     pre_act = _var(helper, out)
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
                                     is_bias=True)
         out2 = _out(helper, input.dtype)
         helper.append_op("elementwise_add", inputs={"X": [pre_act], "Y": [b]},
-                         outputs={"Out": [out2]}, attrs={"axis": 1})
+                         outputs={"Out": [out2]},
+                         attrs={"axis": 1 if data_format == "NCHW" else -1})
         pre_act = _var(helper, out2)
     return helper.append_activation(pre_act)
 
@@ -157,7 +161,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, use_cudnn=True, ceil_mode=False, name=None,
-           exclusive=True, adaptive=False):
+           exclusive=True, adaptive=False, data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     out = _out(helper, input.dtype)
     helper.append_op(
@@ -170,7 +174,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
                "paddings": [pool_padding, pool_padding]
                if isinstance(pool_padding, int) else list(pool_padding),
                "global_pooling": global_pooling, "exclusive": exclusive,
-               "adaptive": adaptive})
+               "adaptive": adaptive, "data_format": data_format})
     return _var(helper, out)
 
 

@@ -2,10 +2,12 @@
 fluid model-zoo ResNet; built from layers.conv2d/batch_norm exactly as a fluid user
 would).
 
-TPU notes: NCHW layout as in the reference; XLA relayouts for the MXU. Build with
-dtype='bfloat16' for the MXU-native path (batch-norm statistics stay f32 inside the
-op). The first 7x7 conv, the 3x3 stage convs and the final fc dominate FLOPs and all
-lower to single conv/dot HLOs -- no per-op kernel dispatch.
+TPU notes: build with data_format='NHWC' (channels-last) for the TPU-preferred
+layout -- channels ride the minor (lane) dimension so XLA feeds the MXU without
+relayout transposes -- and dtype='bfloat16' for the MXU-native path (batch-norm
+statistics stay f32 inside the op). The default stays NCHW for parity with the
+reference. The first 7x7 conv, the 3x3 stage convs and the final fc dominate FLOPs
+and all lower to single conv/dot HLOs -- no per-op kernel dispatch.
 """
 from __future__ import annotations
 
@@ -14,52 +16,95 @@ from ..layer_helper import ParamAttr
 
 
 def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1, act=None,
-                  name=None, is_test=False):
+                  name=None, is_test=False, data_format="NCHW"):
     conv = layers.conv2d(input, num_filters, filter_size, stride=stride,
                          padding=(filter_size - 1) // 2, groups=groups,
                          bias_attr=False,
-                         param_attr=ParamAttr(name=name + "_w" if name else None))
-    return layers.batch_norm(conv, act=act, is_test=is_test)
+                         param_attr=ParamAttr(name=name + "_w" if name else None),
+                         data_format=data_format)
+    return layers.batch_norm(conv, act=act, is_test=is_test,
+                             data_layout=data_format)
 
 
-def shortcut(input, ch_out, stride, name=None, is_test=False):
-    ch_in = input.shape[1]
+def shortcut(input, ch_out, stride, name=None, is_test=False,
+             data_format="NCHW"):
+    ch_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     if ch_in != ch_out or stride != 1:
         return conv_bn_layer(input, ch_out, 1, stride, name=name,
-                             is_test=is_test)
+                             is_test=is_test, data_format=data_format)
     return input
 
 
-def bottleneck_block(input, num_filters, stride, name=None, is_test=False):
+def bottleneck_block(input, num_filters, stride, name=None, is_test=False,
+                     data_format="NCHW"):
     conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
-                          name=name and name + "_c0", is_test=is_test)
+                          name=name and name + "_c0", is_test=is_test,
+                          data_format=data_format)
     conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride, act="relu",
-                          name=name and name + "_c1", is_test=is_test)
+                          name=name and name + "_c1", is_test=is_test,
+                          data_format=data_format)
     conv2 = conv_bn_layer(conv1, num_filters * 4, 1,
-                          name=name and name + "_c2", is_test=is_test)
+                          name=name and name + "_c2", is_test=is_test,
+                          data_format=data_format)
     short = shortcut(input, num_filters * 4, stride,
-                     name=name and name + "_sc", is_test=is_test)
+                     name=name and name + "_sc", is_test=is_test,
+                     data_format=data_format)
     return layers.relu(layers.elementwise_add(short, conv2))
 
 
 _DEPTHS = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
 
 
-def resnet(img, label, depth=50, num_classes=1000, is_test=False):
+def _space_to_depth2(img, data_format):
+    """2x2 space-to-depth. NCHW reuses the registered space_to_depth op;
+    NHWC is the same permutation expressed channels-last (pure
+    reshape/transpose -- XLA fuses it into the consuming conv)."""
+    from .. import layers
+    if data_format == "NCHW":
+        return layers.space_to_depth(img, 2)
+    n, h, w, c = img.shape
+    x = layers.reshape(img, [-1, h // 2, 2, w // 2, 2, c])
+    x = layers.transpose(x, [0, 1, 3, 2, 4, 5])
+    return layers.reshape(x, [-1, h // 2, w // 2, 4 * c])
+
+
+def resnet(img, label, depth=50, num_classes=1000, is_test=False,
+           data_format="NCHW", conv1_space_to_depth=False):
     """Returns (loss, acc, logits) — logits only if label is None.
-    img: [N,3,H,W], label: [N,1] int64. is_test freezes batch-norm to the
-    moving averages (the inference graph)."""
+    img: [N,3,H,W] (NCHW) or [N,H,W,3] (NHWC), label: [N,1] int64. is_test
+    freezes batch-norm to the moving averages (the inference graph).
+
+    conv1_space_to_depth: TPU perf mode. The stock 7x7/s2 stem conv has 3
+    input channels -- 3/128 of the MXU's contraction lanes -- so the stem
+    runs an order of magnitude below peak. Re-expressing it as a 2x2
+    space-to-depth followed by a 4x4/s1 conv over 12 channels (the
+    zero-padded-8x8-kernel factorization MLPerf ResNet uses on TPU) keeps
+    the same receptive field and output shape with 4x the MXU occupancy.
+    The stem weight becomes [64, 12, 4, 4] (train-from-scratch mode; not
+    checkpoint-compatible with the 7x7 stem)."""
     stages = _DEPTHS[depth]
     filters = [64, 128, 256, 512]
-    h = conv_bn_layer(img, 64, 7, stride=2, act="relu", name="conv1",
-                      is_test=is_test)
-    h = layers.pool2d(h, 3, "max", 2, pool_padding=1)
+    if conv1_space_to_depth:
+        h = _space_to_depth2(img, data_format)
+        # offsets k in {-2..1} of the factored kernel -> pad (2 before, 1
+        # after) each spatial dim; output stays H/2 x W/2.
+        h = layers.conv2d(h, 64, 4, stride=1, padding=[2, 1, 2, 1],
+                          bias_attr=False,
+                          param_attr=ParamAttr(name="conv1_w"),
+                          data_format=data_format)
+        h = layers.batch_norm(h, act="relu", is_test=is_test,
+                              data_layout=data_format)
+    else:
+        h = conv_bn_layer(img, 64, 7, stride=2, act="relu", name="conv1",
+                          is_test=is_test, data_format=data_format)
+    h = layers.pool2d(h, 3, "max", 2, pool_padding=1, data_format=data_format)
     for stage, (n_blocks, nf) in enumerate(zip(stages, filters)):
         for i in range(n_blocks):
             stride = 2 if i == 0 and stage > 0 else 1
             h = bottleneck_block(h, nf, stride, name=f"res{stage}_{i}",
-                                 is_test=is_test)
-    h = layers.pool2d(h, pool_type="avg", global_pooling=True)
+                                 is_test=is_test, data_format=data_format)
+    h = layers.pool2d(h, pool_type="avg", global_pooling=True,
+                      data_format=data_format)
     logits = layers.fc(h, num_classes)
     if label is None:
         return logits
@@ -68,5 +113,8 @@ def resnet(img, label, depth=50, num_classes=1000, is_test=False):
     return loss, acc, logits
 
 
-def resnet50(img, label, num_classes=1000, is_test=False):
-    return resnet(img, label, 50, num_classes, is_test=is_test)
+def resnet50(img, label, num_classes=1000, is_test=False, data_format="NCHW",
+             conv1_space_to_depth=False):
+    return resnet(img, label, 50, num_classes, is_test=is_test,
+                  data_format=data_format,
+                  conv1_space_to_depth=conv1_space_to_depth)

@@ -31,20 +31,32 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def _conv_pads(pads):
+    """[ph, pw] (symmetric) or [top, bottom, left, right] (asymmetric)."""
+    if len(pads) == 4:
+        return [(pads[0], pads[1]), (pads[2], pads[3])]
+    return [(pads[0], pads[0]), (pads[1], pads[1])]
+
+
 def _conv(ctx, ins, depthwise=False):
     lax = _lax()
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(ctx.attr("strides", [1, 1]))
-    pads = _pair(ctx.attr("paddings", [0, 0]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))  # 2-elem symmetric or 4-elem
     dil = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
+    # data_format: activations NCHW (reference default) or NHWC (TPU-preferred;
+    # channels-minor keeps XLA from inserting relayout transposes around the MXU
+    # conv). Filter stays OIHW in both cases so parameter shapes/checkpoints are
+    # layout-independent.
+    fmt = ctx.attr("data_format", "NCHW") or "NCHW"
     if depthwise:
-        groups = x.shape[1]
+        groups = x.shape[1] if fmt == "NCHW" else x.shape[-1]
     out = lax.conv_general_dilated(
         x, w, window_strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        padding=_conv_pads(pads),
         rhs_dilation=dil, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
         preferred_element_type=None)
     return {"Output": [out]}
 
@@ -119,19 +131,30 @@ def pool2d(ctx, ins):
     k = _pair(ctx.attr("ksize", [2, 2]))
     s = _pair(ctx.attr("strides", [2, 2]))
     p = _pair(ctx.attr("paddings", [0, 0]))
+    fmt = ctx.attr("data_format", "NCHW") or "NCHW"
+    sp_axes = (2, 3) if fmt == "NCHW" else (1, 2)
     if ctx.attr("global_pooling", False):
         if ptype == "max":
-            return {"Out": [jnp.max(x, axis=(2, 3), keepdims=True)]}
-        return {"Out": [jnp.mean(x, axis=(2, 3), keepdims=True)]}
+            return {"Out": [jnp.max(x, axis=sp_axes, keepdims=True)]}
+        return {"Out": [jnp.mean(x, axis=sp_axes, keepdims=True)]}
     if ctx.attr("adaptive", False):
         # adaptive pooling to output k: split H/W into k bins (requires divisibility)
-        n, c, h, w_ = x.shape
-        xb = x.reshape(n, c, k[0], h // k[0], k[1], w_ // k[1])
         red = jnp.max if ptype == "max" else jnp.mean
-        return {"Out": [red(xb, axis=(3, 5))]}
-    window = (1, 1) + k
-    strides = (1, 1) + s
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+        if fmt == "NCHW":
+            n, c, h, w_ = x.shape
+            xb = x.reshape(n, c, k[0], h // k[0], k[1], w_ // k[1])
+            return {"Out": [red(xb, axis=(3, 5))]}
+        n, h, w_, c = x.shape
+        xb = x.reshape(n, k[0], h // k[0], k[1], w_ // k[1], c)
+        return {"Out": [red(xb, axis=(2, 4))]}
+    if fmt == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
     if ptype == "max":
         init = -jnp.inf if np.issubdtype(np.dtype(str(x.dtype)) if str(x.dtype) !=
                                          "bfloat16" else np.float32, np.floating) else 0

@@ -50,6 +50,48 @@ def test_resnet18_like_builds_and_steps():
     assert np.isfinite(outs[0]).all()
 
 
+def test_resnet_nhwc_matches_nchw_and_s2d_trains():
+    """The TPU-preferred layout (data_format='NHWC') must produce identical
+    training losses to the reference NCHW path, and the space-to-depth stem
+    (conv1_space_to_depth) must build and train. Covers the conv2d/pool2d/
+    batch_norm data_format attrs and 4-element asymmetric conv padding."""
+    resnet._DEPTHS[8] = [1, 1, 1, 1]
+    rng = np.random.RandomState(0)
+    img_nchw = rng.randn(4, 3, 32, 32).astype("float32")
+    label = rng.randint(0, 10, (4, 1)).astype("int64")
+
+    def run(fmt, s2d=False):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 0
+        startup.random_seed = 0
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            shape = [3, 32, 32] if fmt == "NCHW" else [32, 32, 3]
+            img = fluid.data("img", shape, "float32")
+            lab = fluid.data("label", [1], "int64")
+            loss, _, _ = resnet.resnet(img, lab, depth=8, num_classes=10,
+                                       data_format=fmt,
+                                       conv1_space_to_depth=s2d)
+            fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+        feed_img = (img_nchw if fmt == "NCHW"
+                    else np.ascontiguousarray(img_nchw.transpose(0, 2, 3, 1)))
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            return [float(np.asarray(exe.run(
+                main, feed={"img": feed_img, "label": label},
+                fetch_list=[loss])[0]).reshape(-1)[0]) for _ in range(3)]
+
+    nchw = run("NCHW")
+    nhwc = run("NHWC")
+    # identical math, different reduction orders: divergence compounds over
+    # the training steps, so step 0 is tight and the tail is looser
+    np.testing.assert_allclose(nchw[0], nhwc[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(nchw, nhwc, rtol=3e-3, atol=3e-3)
+    s2d_losses = run("NHWC", s2d=True) + run("NCHW", s2d=True)
+    assert np.isfinite(s2d_losses).all()
+
+
 def _tiny_bert_cfg():
     return bert.BertConfig(vocab_size=128, hidden=32, n_layers=2, n_heads=4,
                            max_seq_len=16, dropout=0.1)
