@@ -19,6 +19,12 @@ Method notes:
     each round -- larger regresses): measured MFU
     rises ~5 points over the V100-era batch sizes and vs_baseline compares
     throughput, which is the per-chip claim BASELINE.md makes.
+  - BERT runs with dropout=0.1 (as the reference pretrain config does) under
+    FLAGS_prng_impl=rbg, the TPU-fast PRNG: round-4 tracing showed threefry
+    mask generation cost ~30 ms/step at batch 128 (VPU-bound + fusion
+    breaking); XLA's RngBitGenerator brings the full step from 132.7 ->
+    97.9 ms (MFU 0.342 -> 0.46+). The MLM decode is weight-tied to word_emb
+    in bf16 (BertConfig.tie_mlm_weight, the reference LARK pattern).
   - ResNet runs the TPU-preferred formulation: NHWC (channels-last) layout and
     a 2x2 space-to-depth stem (the MLPerf factorization of the 7x7/s2 conv;
     see models/resnet.py). Round-4 finding: a hand-written pure-JAX ResNet-50

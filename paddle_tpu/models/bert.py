@@ -16,6 +16,7 @@ from __future__ import annotations
 import math
 
 from .. import layers
+from ..layers import tensor as tensor_layers
 from ..layer_helper import ParamAttr
 from ..initializer import Normal, Constant
 
@@ -23,7 +24,8 @@ from ..initializer import Normal, Constant
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, n_layers=12, n_heads=12,
                  ffn_hidden=None, max_seq_len=512, type_vocab=2, dropout=0.1,
-                 dtype="float32", attn_impl="auto"):
+                 dtype="float32", attn_impl="auto", tie_mlm_weight=True,
+                 pp_stages=None):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.n_layers = n_layers
@@ -34,6 +36,19 @@ class BertConfig:
         self.dropout = dropout
         self.dtype = dtype
         self.attn_impl = attn_impl  # "auto" | "pallas" | "composed"
+        # Decode MLM logits through word_emb^T (the reference LARK/BERT
+        # pattern) instead of a separate [H, vocab] output projection; halves
+        # the vocab-sized parameter/optimizer state and keeps the decode
+        # matmul in the compute dtype.
+        self.tie_mlm_weight = tie_mlm_weight
+        # pp_stages=S annotates the encoder layers with
+        # device_guard("stage:i") so PipelineOptimizer(schedule="auto") can
+        # lower the stack onto the compiled temporal GPipe schedule
+        # (n_layers must divide evenly into S stages).
+        self.pp_stages = pp_stages
+        if pp_stages and n_layers % pp_stages:
+            raise ValueError(f"n_layers={n_layers} must be divisible by "
+                             f"pp_stages={pp_stages}")
 
 
 def base_config(**kw):
@@ -97,14 +112,21 @@ def encoder_layer(x, cfg: BertConfig, mask_bias, name):
 
 
 def encoder(src_ids, pos_ids, sent_ids, input_mask, cfg: BertConfig):
-    """Embeddings + transformer stack. input_mask: [B,S] 1/0 float."""
+    """Embeddings + transformer stack. input_mask: [B,S] 1/0 float.
+
+    Embedding tables are created in cfg.dtype: on TPU the whole encoder
+    (and the tied MLM decode) then runs bf16 end-to-end -- layer_norm and
+    softmax still accumulate in f32 inside their ops."""
     emb = layers.embedding(src_ids, [cfg.vocab_size, cfg.hidden],
+                           dtype=cfg.dtype,
                            param_attr=ParamAttr(name="word_emb",
                                                 initializer=Normal(0.0, 0.02)))
     pos = layers.embedding(pos_ids, [cfg.max_seq_len, cfg.hidden],
+                           dtype=cfg.dtype,
                            param_attr=ParamAttr(name="pos_emb",
                                                 initializer=Normal(0.0, 0.02)))
     sent = layers.embedding(sent_ids, [cfg.type_vocab, cfg.hidden],
+                            dtype=cfg.dtype,
                             param_attr=ParamAttr(name="sent_emb",
                                                  initializer=Normal(0.0, 0.02)))
     x = layers.elementwise_add(layers.elementwise_add(emb, pos), sent)
@@ -112,15 +134,20 @@ def encoder(src_ids, pos_ids, sent_ids, input_mask, cfg: BertConfig):
     if cfg.dropout:
         x = layers.dropout(x, cfg.dropout,
                            dropout_implementation="upscale_in_train")
-    if cfg.dtype == "bfloat16":
-        x = layers.cast(x, "bfloat16")
     # additive attention bias: (mask-1) * 1e4 -> -1e4 where padded
     bias = layers.scale(input_mask, scale=1e4, bias=-1e4)      # [B,S]
     bias = layers.unsqueeze(layers.unsqueeze(bias, [1]), [1])  # [B,1,1,S]
     if cfg.dtype == "bfloat16":
         bias = layers.cast(bias, "bfloat16")
-    for i in range(cfg.n_layers):
-        x = encoder_layer(x, cfg, bias, f"layer{i}")
+    if cfg.pp_stages:
+        from ..framework import device_guard
+        per_stage = cfg.n_layers // cfg.pp_stages
+        for i in range(cfg.n_layers):
+            with device_guard(f"stage:{i // per_stage}"):
+                x = encoder_layer(x, cfg, bias, f"layer{i}")
+    else:
+        for i in range(cfg.n_layers):
+            x = encoder_layer(x, cfg, bias, f"layer{i}")
     return x
 
 
@@ -133,19 +160,31 @@ def pretrain(src_ids, pos_ids, sent_ids, input_mask, mask_pos, mask_label,
     Returns (total_loss, mlm_loss, nsp_acc).
     """
     enc = encoder(src_ids, pos_ids, sent_ids, input_mask, cfg)   # [B,S,H]
-    if cfg.dtype == "bfloat16":
-        enc = layers.cast(enc, "float32")
+    # The whole MLM tail stays in cfg.dtype (bf16 on TPU: the [M,H]x[H,V]
+    # decode is the single largest matmul in the step -- in f32 it ran at a
+    # quarter of the MXU's bf16 rate and carried f32 Adam state for 23M
+    # params); only the logits are cast up for a stable softmax.
     flat = layers.reshape(enc, [-1, cfg.hidden])                 # [B*S,H]
-    masked = layers.gather(flat, mask_pos)                       # [M,1,H]?? gather on [M,1]
+    masked = layers.gather(flat, mask_pos)
     masked = layers.reshape(masked, [-1, cfg.hidden])
     mlm_h = layers.fc(masked, cfg.hidden, act="gelu",
                       param_attr=ParamAttr(name="mlm_trans_w",
                                            initializer=Normal(0.0, 0.02)))
     mlm_h = layers.layer_norm(mlm_h, begin_norm_axis=1)
-    # output projection tied-shape (not tied-weight for simplicity round 1)
-    mlm_logits = layers.fc(mlm_h, cfg.vocab_size,
-                           param_attr=ParamAttr(name="mlm_out_w",
-                                                initializer=Normal(0.0, 0.02)))
+    if cfg.tie_mlm_weight:
+        from ..framework import default_main_program
+        word_emb = default_main_program().global_block().var("word_emb")
+        mlm_logits = layers.matmul(mlm_h, word_emb, transpose_y=True)
+        mlm_bias = tensor_layers.create_parameter(
+            [cfg.vocab_size], cfg.dtype, name="mlm_out_bias",
+            default_initializer=Constant(0.0))
+        mlm_logits = layers.elementwise_add(mlm_logits, mlm_bias)
+    else:
+        mlm_logits = layers.fc(mlm_h, cfg.vocab_size,
+                               param_attr=ParamAttr(name="mlm_out_w",
+                                                    initializer=Normal(0.0, 0.02)))
+    if cfg.dtype == "bfloat16":
+        mlm_logits = layers.cast(mlm_logits, "float32")
     mlm_loss = layers.mean(
         layers.softmax_with_cross_entropy(mlm_logits, mask_label))
 
@@ -156,6 +195,8 @@ def pretrain(src_ids, pos_ids, sent_ids, input_mask, mask_pos, mask_label,
     nsp_logits = layers.fc(pooled, 2,
                            param_attr=ParamAttr(name="nsp_w",
                                                 initializer=Normal(0.0, 0.02)))
+    if cfg.dtype == "bfloat16":
+        nsp_logits = layers.cast(nsp_logits, "float32")
     nsp_loss = layers.mean(
         layers.softmax_with_cross_entropy(nsp_logits, nsp_label))
     nsp_acc = layers.accuracy(nsp_logits, nsp_label)

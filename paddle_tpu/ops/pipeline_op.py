@@ -1,0 +1,121 @@
+"""temporal_pipeline op: a device_guard-annotated stage stack compiled to the
+GPipe schedule.
+
+Reference analog: PipelineTrainer/SectionWorker (framework/trainer.h:115,
+section_worker.cc:85,141) run program *sections* as threads streaming Scopes;
+the cut points come from PipelineOptimizer (optimizer.py:2985). Here the op
+carries one template sub-block (the stage body) plus per-position parameter
+stacks [S, ...]; on a mesh with the pipeline axis it lowers through
+parallel/pipeline.pipeline_spmd -- an explicit shard_map whose lax.scan runs
+the classic M + S - 1 tick GPipe skew with lax.ppermute handing activations to
+the next device. Off-mesh (single device, shape inference, CPU tests) it
+lowers to the mathematically identical serial schedule: lax.scan over the
+stage axis per microbatch.
+
+Inputs:  X [B, ...] (the stage-0 activation, pre-split into microbatches
+         here), Params: K stacked tensors [S, ...].
+Attrs:   sub_block (template ops, expressed over stage-0 var names),
+         in_var / out_var (template activation names), param_vars (template
+         param names, aligned with Params), const_vars (stage-invariant vars
+         read from the enclosing scope, e.g. attention mask bias),
+         num_stages S, num_microbatches M, axis.
+Output:  Out [B, ...] after all S stages.
+
+Gradient: the generic auto-vjp differentiates straight through the shard_map
+(ppermute's transpose is the reverse permute), so dParams arrive stacked --
+the optimizer's per-parameter state is stage-stacked too and shards over the
+same axis.
+
+RNG note: ops with PRNG draws (dropout) inside the template draw the *same*
+stream in every stage (the template's op salts). Stage-decorrelated streams
+would need the stage index folded into the key inside shard_map; until then
+prefer dropout=0 or the microbatch-scan schedule for stochastic stacks.
+"""
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _infer_shape(op, block):
+    """Out mirrors X (homogeneous stages preserve the activation shape)."""
+    x = block.find_var_recursive(op.inputs["X"][0])
+    for n in op.outputs.get("Out", []):
+        v = block.create_var(n, x.shape, x.dtype)
+        v.stop_gradient = False
+
+
+@register("temporal_pipeline", infer_shape=_infer_shape)
+def temporal_pipeline(ctx, ins):
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    params = tuple(ins["Params"])
+    consts = tuple(ins.get("Consts", ()))
+    S = int(ctx.attr("num_stages"))
+    M = int(ctx.attr("num_microbatches", 1))
+    axis = ctx.attr("axis", "pp")
+    in_var = ctx.attr("in_var")
+    # the template block is stage 0's ops, so the per-stage result is read
+    # under stage 0's output name (the program-level Out var is the last
+    # stage's name -- only the surrounding block knows it)
+    out_var = ctx.attr("template_out")
+    pvars = list(ctx.attr("param_vars", []))
+    cvars = list(ctx.attr("const_vars", []))
+    blk_idx = int(ctx.attr("sub_block"))
+    runner = ctx.block_runner
+    if runner is None:
+        raise RuntimeError("temporal_pipeline needs the executor's sub-block "
+                           "runner (it cannot be evaluated standalone)")
+
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"temporal_pipeline: batch {B} not divisible by "
+                         f"num_microbatches {M}")
+
+    def to_mb(t):
+        return t.reshape((M, B // M) + t.shape[1:])
+
+    # Consts whose leading dim is the batch (attention mask bias) are
+    # per-example: they are microbatched and ride the carried pytree through
+    # the pipe so each stage sees the slice matching its current microbatch.
+    # Scalar/stage-invariant consts replicate.
+    batch_idx, static_idx = [], []
+    for i, c in enumerate(consts):
+        if getattr(c, "ndim", 0) >= 1 and c.shape[0] == B:
+            batch_idx.append(i)
+        else:
+            static_idx.append(i)
+
+    def stage_fn(stage_params, carry, static_cs):
+        h = carry[0]
+        env = {in_var: h}
+        env.update(dict(zip(pvars, stage_params)))
+        for j, i in enumerate(batch_idx):
+            env[cvars[i]] = carry[1 + j]
+        for j, i in enumerate(static_idx):
+            env[cvars[i]] = static_cs[j]
+        out = runner(blk_idx, env)[out_var]
+        return (out,) + tuple(carry[1:])   # side inputs pass through
+
+    xs_tree = (to_mb(x),) + tuple(to_mb(consts[i]) for i in batch_idx)
+    static_cs = tuple(consts[i] for i in static_idx)
+
+    mesh = ctx.gspmd_mesh
+    on_mesh = (mesh is not None and axis in mesh.shape
+               and mesh.shape[axis] == S and not ctx.abstract)
+    if on_mesh:
+        from ..parallel.pipeline import pipeline_spmd
+        mb_axis = "dp" if mesh.shape.get("dp", 1) > 1 else None
+        ys = pipeline_spmd(stage_fn, params, xs_tree, mesh, axis=axis,
+                           consts=static_cs, mb_axis=mb_axis)[0]
+    else:
+        # serial schedule: same per-microbatch, per-stage math, no pipe skew
+        def run_mb(carry):
+            def body(c, stage_params):
+                return stage_fn(stage_params, c, static_cs), None
+            out, _ = jax.lax.scan(body, carry, params)
+            return out[0]
+
+        ys = jax.lax.map(run_mb, xs_tree)
+    return {"Out": [ys.reshape((B,) + ys.shape[2:])]}

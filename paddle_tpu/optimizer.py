@@ -503,11 +503,22 @@ class PipelineOptimizer:
 
     def __init__(self, optimizer, num_microbatches=1, cut_list=None,
                  place_list=None, concurrency_list=None, queue_size=None,
-                 sync_steps=None, start_cpu_core_id=0):
+                 sync_steps=None, start_cpu_core_id=0, schedule="auto",
+                 pipeline_axis="pp"):
         self._optimizer = optimizer
         self._m = int(num_microbatches)
         # cut/place/concurrency/queue knobs are the reference's thread-section
         # tuning surface; scheduling is XLA's job here.
+        # schedule: "auto" lowers device_guard("stage:i")-annotated homogeneous
+        # stage stacks into the compiled temporal GPipe schedule
+        # (ops/pipeline_op.py + parallel/pipeline.py) and falls back to the
+        # microbatch scan otherwise; "scan" forces the scan; "temporal"
+        # requires stage annotations and raises when they cannot lower.
+        if schedule not in ("auto", "scan", "temporal"):
+            raise ValueError(f"schedule must be auto|scan|temporal, "
+                             f"got {schedule!r}")
+        self._schedule = schedule
+        self._axis = pipeline_axis
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
@@ -519,8 +530,19 @@ class PipelineOptimizer:
         from .framework import program_guard
         program = loss.block.program
         block = program.global_block()
-        with program_guard(program,
-                           startup_program or default_startup_program()):
+        startup = startup_program or default_startup_program()
+        if self._schedule in ("auto", "temporal"):
+            rewrote = _rewrite_temporal_pipeline(
+                program, startup, self._m, self._axis,
+                required=self._schedule == "temporal")
+            if rewrote:
+                with program_guard(program, startup):
+                    params_grads = self._optimizer.backward(
+                        loss, startup_program, parameter_list, no_grad_set)
+                    pg = [(p, g) for p, g in params_grads if g is not None]
+                    ops = self._optimizer.apply_gradients(pg)
+                return ops, params_grads
+        with program_guard(program, startup):
             params_grads = self._optimizer.backward(
                 loss, startup_program, parameter_list, no_grad_set)
             if self._m <= 1:
@@ -532,6 +554,217 @@ class PipelineOptimizer:
                   if g is not None]
             ops = self._optimizer.apply_gradients(pg)
         return ops, params_grads
+
+    @staticmethod
+    def pp_param_rules(axis="pp"):
+        """DistributedStrategy param_rules sharding the stage-stacked
+        parameters (and their stage-stacked optimizer accumulators) over the
+        pipeline axis. Scalar accumulators derived from stacked params
+        (Adam's beta-pow) stay replicated -- first match wins."""
+        return [(r"@pp_stacked.*_pow_acc", ()),
+                (r"@pp_stacked", (axis,))]
+
+
+def _rewrite_temporal_pipeline(program: Program, startup, M, axis="pp",
+                               required=False):
+    """Lower device_guard("stage:i")-annotated ops into one temporal_pipeline
+    op (the compiled GPipe schedule; reference PipelineTrainer/SectionWorker,
+    trainer.h:115, section_worker.cc:85).
+
+    Requirements (the homogeneous-stage contract of parallel/pipeline.py):
+      - annotated ops are contiguous and stage ids increase monotonically;
+      - every stage has the same op-type/attr sequence with positionally
+        matching parameter shapes (a transformer layer stack);
+      - consecutive stages are linked by exactly one activation (cut) var of
+        a shape shared by all cuts; other stage inputs must come from the
+        prologue (stage-invariant consts, e.g. the attention mask bias).
+
+    On success: per-stage parameters are replaced by [S, ...] stacks (named
+    <stage0 param>@pp_stacked, initialized in the startup program by stacking
+    the per-stage inits), the stage ops move into a template sub-block, and
+    the main block gets one temporal_pipeline op. Returns True. On any
+    violated requirement: returns False (schedule="auto") or raises
+    (schedule="temporal").
+    """
+    from .framework import Parameter
+
+    block = program.global_block()
+    ops = list(block.ops)
+
+    def stage_of(op):
+        d = op.attr("op_device", None)
+        if isinstance(d, str) and d.startswith("stage:"):
+            return int(d.split(":", 1)[1])
+        return None
+
+    tagged = [i for i, o in enumerate(ops) if stage_of(o) is not None]
+
+    def bail(msg):
+        if required:
+            raise ValueError(f"PipelineOptimizer(schedule='temporal'): {msg}")
+        return False
+
+    if not tagged:
+        return bail("no device_guard('stage:i') annotations found")
+    first, last = tagged[0], tagged[-1]
+    prologue, staged, epilogue = ops[:first], ops[first:last + 1], ops[last + 1:]
+
+    stages, cur = [], None
+    for o in staged:
+        s = stage_of(o)
+        if s is None:
+            return bail(f"un-annotated op {o.type!r} inside the stage region")
+        if s != cur:
+            if cur is not None and s != cur + 1:
+                return bail(f"stage ids must increase by 1 (saw {cur} -> {s})")
+            if cur is None and s != 0:
+                return bail(f"stages must start at 0 (saw stage:{s} first)")
+            stages.append([])
+            cur = s
+        stages[-1].append(o)
+    S = len(stages)
+    if S < 2:
+        return bail("need at least 2 stages")
+
+    # homogeneity: identical op type + attr sequences (modulo the stage tag)
+    def sig(sops):
+        out = []
+        for o in sops:
+            attrs = {k: v for k, v in o.attrs.items() if k != "op_device"}
+            out.append((o.type, tuple(sorted(
+                (k, repr(v)) for k, v in attrs.items()))))
+        return out
+    template_sig = sig(stages[0])
+    for i, sops in enumerate(stages[1:], 1):
+        if sig(sops) != template_sig:
+            return bail(f"stage {i} op sequence differs from stage 0 "
+                        f"(homogeneous stacks only; use schedule='scan' for "
+                        f"heterogeneous sections)")
+
+    produced = [set(n for o in sops for ns in o.outputs.values() for n in ns)
+                for sops in stages]
+    consumed = [set(n for o in sops for ns in o.inputs.values() for n in ns)
+                for sops in stages]
+    epi_consumed = set(n for o in epilogue for ns in o.inputs.values()
+                       for n in ns)
+
+    def params_of(sops):
+        seen, out = set(), []
+        for o in sops:
+            for slot in sorted(o.inputs):
+                for n in o.inputs[slot]:
+                    v = block.find_var_recursive(n)
+                    if isinstance(v, Parameter) and n not in seen:
+                        seen.add(n)
+                        out.append(n)
+        return out
+
+    stage_params = [params_of(sops) for sops in stages]
+    K = len(stage_params[0])
+    for i, ps in enumerate(stage_params[1:], 1):
+        if len(ps) != K:
+            return bail(f"stage {i} has {len(ps)} params, stage 0 has {K}")
+        for a, b in zip(stage_params[0], ps):
+            va, vb = block.var(a), block.var(b)
+            if tuple(va.shape) != tuple(vb.shape) or va.dtype != vb.dtype:
+                return bail(f"param {b!r} ({vb.shape}) does not match stage-0 "
+                            f"{a!r} ({va.shape})")
+
+    # cut vars: single activation handed stage i -> i+1 (and last -> epilogue)
+    cuts = []
+    for i in range(1, S):
+        link = consumed[i] & produced[i - 1]
+        if len(link) != 1:
+            return bail(f"stages {i-1}->{i} must be linked by exactly one "
+                        f"activation var (found {sorted(link)})")
+        cuts.append(next(iter(link)))
+    out_link = epi_consumed & produced[S - 1]
+    if len(out_link) != 1:
+        return bail(f"last stage must hand exactly one var to the epilogue "
+                    f"(found {sorted(out_link)})")
+    out_var = next(iter(out_link))
+    # no skip connections across stages: stage i's outputs may only be read
+    # by stage i+1 (the cut) -- or the epilogue for the last stage
+    for i in range(S - 1):
+        later = set().union(*consumed[i + 2:]) if i + 2 < S else set()
+        later |= epi_consumed
+        leak = produced[i] & later
+        if leak:
+            return bail(f"stage {i} outputs {sorted(leak)} consumed beyond "
+                        f"stage {i+1} (single-cut chains only)")
+
+    # stage inputs that are neither params nor the cut: stage-invariant consts
+    pro_avail = set(n for o in prologue for ns in o.outputs.values()
+                    for n in ns)
+    pro_avail |= {n for n, v in block.vars.items() if v.is_data}
+    for i in range(S):
+        cut_in = cuts[i - 1] if i > 0 else None
+        for n in sorted(consumed[i]):
+            if n in stage_params[i] or n == cut_in or n in produced[i]:
+                continue
+            if n not in pro_avail:
+                return bail(f"stage {i} reads {n!r} which is neither a "
+                            f"param, the cut activation, nor a prologue "
+                            f"output")
+    # classify stage-0 non-param inputs: consts are read by stage >= 1 too
+    later_consumed = set().union(*consumed[1:]) if S > 1 else set()
+    cand = [n for n in sorted(consumed[0])
+            if n not in stage_params[0] and n not in produced[0]]
+    const_vars = [n for n in cand if n in later_consumed]
+    ins0 = [n for n in cand if n not in later_consumed]
+    if len(ins0) != 1:
+        return bail(f"stage 0 must consume exactly one activation from the "
+                    f"prologue (found {ins0}); stage-invariant inputs must "
+                    f"also be read by later stages to classify as consts")
+    in_var = ins0[0]
+
+    # cut shapes must all match (homogeneous activation)
+    shapes = {tuple(block.var(n).shape) for n in cuts + [in_var, out_var]}
+    if len(shapes) != 1:
+        return bail(f"cut activations must share one shape, found {shapes}")
+
+    # ---- build: template sub-block + stacked params + the pipeline op ------
+    sub = program._create_block(parent_idx=0)
+    program._rollback()
+    sub.ops = stages[0]
+
+    stacked_names = []
+    sblock = startup.global_block()
+    for k in range(K):
+        base = stage_params[0][k]
+        v0 = block.var(base)
+        sname = f"{base}@pp_stacked"
+        block.create_parameter(sname, (S,) + tuple(v0.shape), v0.dtype)
+        stacked_names.append(sname)
+        per_stage = [stage_params[i][k] for i in range(S)]
+        sv = sblock.create_var(sname, (S,) + tuple(v0.shape), v0.dtype)
+        sv.persistable = True
+        sblock.append_op("stack", inputs={"X": per_stage},
+                         outputs={"Y": [sname]}, attrs={"axis": 0},
+                         infer_shape=False)
+        # the per-stage params become startup-internal temporaries: only the
+        # stack persists (keeps checkpoints and executor state stack-only)
+        for i in range(S):
+            block.var(per_stage[i]).persistable = False
+            block.var(per_stage[i]).trainable = False
+            su = sblock.find_var_recursive(per_stage[i])
+            if su is not None:
+                su.persistable = False
+
+    block.ops = list(prologue)
+    block.append_op(
+        "temporal_pipeline",
+        inputs={"X": [in_var], "Params": stacked_names,
+                "Consts": const_vars},
+        outputs={"Out": [out_var]},
+        attrs={"sub_block": sub.idx, "num_stages": S,
+               "num_microbatches": max(M, 1), "axis": axis,
+               "in_var": in_var, "template_out": cuts[0],
+               "param_vars": list(stage_params[0]),
+               "const_vars": const_vars},
+        infer_shape=False)
+    block.ops.extend(epilogue)
+    return True
 
 
 def _rewrite_microbatch_scan(program: Program, loss, params_grads, M):

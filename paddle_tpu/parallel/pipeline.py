@@ -8,76 +8,109 @@ activations flow to the next device with lax.ppermute, and the classic GPipe
 skew fills/drains the pipeline over M + S - 1 ticks inside one lax.scan.
 GSPMD cannot infer temporal schedules like this, hence shard_map.
 
-Requires homogeneous stages (activation shape preserved), the natural shape
-for transformer/BERT layer stacks. For the general heterogeneous-program
-microbatch path use fluid.optimizer.PipelineOptimizer (a program rewrite).
+Requires homogeneous stages (activation structure preserved), the natural
+shape for transformer/BERT layer stacks. For the general heterogeneous-program
+microbatch path use fluid.optimizer.PipelineOptimizer (a program rewrite);
+PipelineOptimizer(schedule="temporal") lowers device_guard-annotated programs
+onto this schedule through ops/pipeline_op.py.
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
+
+# incremented each time the GPipe schedule is traced -- the dryrun's proof
+# that pp actually lowered to the temporal schedule (same pattern as
+# ring_attention.TRACE_COUNT)
+TRACE_COUNT = 0
 
 
 def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x, mesh,
-                  axis: str = "pp"):
+                  axis: str = "pp", consts: Any = None,
+                  mb_axis: Optional[str] = None):
     """Run a homogeneous S-stage pipeline over microbatches.
 
-    stage_fn(params_one_stage, x_mb) -> y_mb with y.shape == x.shape.
+    stage_fn(params_one_stage, x_mb) -> y_mb, where x_mb/y_mb are pytrees of
+        identical structure and shapes (per-example side inputs -- attention
+        mask slices -- ride the pytree through the pipe untouched); called as
+        stage_fn(params, x_mb, consts) when ``consts`` is given.
     stacked_params: pytree whose leaves have a leading stage axis S
         (sharded over ``axis`` on ``mesh``).
-    x: [M, mb, ...] microbatches (replicated).
-    Returns [M, mb, ...] outputs after all S stages (replicated).
+    x: pytree of [M, mb, ...] microbatched arrays.
+    consts: optional pytree of stage-invariant values replicated everywhere.
+    mb_axis: optional mesh axis to shard the microbatch (dim 1) over -- the
+        data-parallel axis when pipelining composes with dp.
+    Returns the pytree of [M, mb, ...] outputs after all S stages.
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    global TRACE_COUNT
+    TRACE_COUNT += 1
+    tree = jax.tree_util
     S = mesh.shape[axis]
-    M = x.shape[0]
+    leaves = tree.tree_leaves(x)
+    M = leaves[0].shape[0]
+    have_consts = consts is not None
+    if consts is None:
+        consts = ()
 
-    def per_device(params, xs):
-        # params leaves: [1, ...] local stage slice; xs: [M, mb, ...]
+    def per_device(params, xs, cs):
+        # params leaves: [1, ...] local stage slice; xs leaves: [M, mb, ...]
         idx = jax.lax.axis_index(axis)
-        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        local = tree.tree_map(lambda p: p[0], params)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
-        state0 = jnp.zeros_like(xs[0])
-        outbuf0 = jnp.zeros_like(xs)
+        def run_stage(inp):
+            if have_consts:
+                return stage_fn(local, inp, cs)
+            return stage_fn(local, inp)
+
+        state0 = tree.tree_map(lambda b: jnp.zeros_like(b[0]), xs)
+        outbuf0 = tree.tree_map(jnp.zeros_like, xs)
 
         def tick(carry, t):
             state, outbuf = carry
             # stage 0 consumes microbatch t while t < M; later stages consume
             # what arrived from the previous device
             feed_idx = jnp.clip(t, 0, M - 1)
-            inp = jnp.where(idx == 0, xs[feed_idx], state)
-            y = stage_fn(local, inp)
+            inp = tree.tree_map(
+                lambda b, st: jnp.where(idx == 0, b[feed_idx], st), xs, state)
+            y = run_stage(inp)
             # last stage emits microbatch t-(S-1) once the pipe is full
             out_t = t - (S - 1)
             emit = jnp.logical_and(idx == S - 1, out_t >= 0)
             outbuf = jax.lax.cond(
                 emit,
-                lambda ob: jax.lax.dynamic_update_index_in_dim(
-                    ob, y, jnp.maximum(out_t, 0), 0),
+                lambda ob: tree.tree_map(
+                    lambda b, yv: jax.lax.dynamic_update_index_in_dim(
+                        b, yv, jnp.maximum(out_t, 0), 0), ob, y),
                 lambda ob: ob, outbuf)
-            state = jax.lax.ppermute(y, axis, perm)
+            state = tree.tree_map(
+                lambda yv: jax.lax.ppermute(yv, axis, perm), y)
             return (state, outbuf), None
 
         (_, outbuf), _ = jax.lax.scan(tick, (state0, outbuf0),
                                       jnp.arange(M + S - 1))
-        # replicate the last stage's buffer to every device
-        mask = (idx == S - 1).astype(outbuf.dtype)
-        return jax.lax.psum(outbuf * mask, axis)
+        # replicate the last stage's buffer to every device along the pipe
+        return tree.tree_map(
+            lambda b: jax.lax.psum(b * (idx == S - 1).astype(b.dtype), axis),
+            outbuf)
 
-    pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    pspec = tree.tree_map(lambda _: P(axis), stacked_params)
+    xspec = tree.tree_map(
+        lambda _: P(None, mb_axis) if mb_axis else P(), x)
+    cspec = tree.tree_map(lambda _: P(), consts) if have_consts else P()
     try:
         fn = shard_map(per_device, mesh=mesh,
-                       in_specs=(pspec, P()), out_specs=P(),
+                       in_specs=(pspec, xspec, cspec), out_specs=xspec,
                        check_vma=False)
     except TypeError:  # pre-0.8 jax spells it check_rep
         fn = shard_map(per_device, mesh=mesh,
-                       in_specs=(pspec, P()), out_specs=P(),
+                       in_specs=(pspec, xspec, cspec), out_specs=xspec,
                        check_rep=False)
-    return fn(stacked_params, x)
+    return fn(stacked_params, x, consts)
