@@ -38,6 +38,9 @@ from . import contrib  # noqa: F401
 from . import flags  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
+from . import average  # noqa: F401
+from . import install_check  # noqa: F401
+from . import net_drawer  # noqa: F401
 from .flags import get_flag, set_flags  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import reader  # noqa: F401

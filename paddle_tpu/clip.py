@@ -81,6 +81,18 @@ def set_gradient_clip(clip, param_list=None, program=None):
         p.gradient_clip = clip
 
 
+def apply_clip_to_all(clip, params_grads):
+    """Apply one explicit clip instance to every gradient (the minimize
+    grad_clip= / dygraph_grad_clip surface). Single dispatch point shared by
+    Optimizer.minimize and contrib.extend_optimizer."""
+    if isinstance(clip, GradientClipByGlobalNorm):
+        clipped = clip.clip_all([(p, g) for p, g in params_grads
+                                 if g is not None])
+        return clipped + [(p, g) for p, g in params_grads if g is None]
+    return [clip._create_operators(p, g) if g is not None else (p, g)
+            for p, g in params_grads]
+
+
 def append_gradient_clip_ops(params_grads):
     """Apply per-param clip attrs; ByGlobalNorm groups all params sharing the attr."""
     global_norm_groups = {}

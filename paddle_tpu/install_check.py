@@ -1,0 +1,39 @@
+"""fluid.install_check.run_check() (reference
+python/paddle/fluid/install_check.py): train one tiny fc step end-to-end on
+the active backend and report success. Exercises DSL -> IR -> backward ->
+optimizer -> XLA on whatever device JAX selected (TPU here, CPU in tests)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_check():
+    import jax
+
+    from . import (Program, program_guard, Executor, Scope, scope_guard,
+                   layers, optimizer, unique_name, data)
+
+    main, startup = Program(), Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with unique_name.guard(), program_guard(main, startup):
+        x = data("install_check_x", [4], "float32")
+        label = data("install_check_y", [1], "int64")
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.fc(x, 4), label))
+        optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        lv, = exe.run(main,
+                      feed={"install_check_x":
+                            rng.randn(8, 4).astype("float32"),
+                            "install_check_y":
+                            rng.randint(0, 4, (8, 1)).astype("int64")},
+                      fetch_list=[loss])
+    assert np.isfinite(np.asarray(lv)).all()
+    dev = jax.devices()[0]
+    print(f"Your paddle_tpu works well on {dev.platform.upper()} "
+          f"({dev.device_kind}).")
+    print("Your paddle_tpu is installed successfully!")

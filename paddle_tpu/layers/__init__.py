@@ -48,3 +48,4 @@ from .sequence import (sequence_pool, sequence_first_step,  # noqa
                        sequence_slice, sequence_enumerate, sequence_erase,
                        sequence_reshape, sequence_scatter)
 from . import collective     # noqa: F401
+from . import distributions  # noqa: F401

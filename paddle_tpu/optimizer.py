@@ -102,6 +102,13 @@ class Optimizer:
                            startup_program or default_startup_program()):
             params_grads = self.backward(loss, startup_program, parameter_list,
                                          no_grad_set)
+            if grad_clip is not None:
+                # explicit clip instance (the dygraph_grad_clip.py surface):
+                # applied to every gradient BEFORE any per-param
+                # set_gradient_clip attrs run in apply_gradients -- the two
+                # compose, so don't mix them on the same params
+                from .clip import apply_clip_to_all
+                params_grads = apply_clip_to_all(grad_clip, params_grads)
             ops = self.apply_gradients(params_grads)
         return ops, params_grads
 

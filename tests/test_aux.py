@@ -151,3 +151,102 @@ def test_checkpointer_rotation_and_resume(tmp_path):
         assert ck2.restore() == 6
         got, = exe.run(main, feed=feed, fetch_list=[loss])
     np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        wa = WeightedAverage()
+    wa.add(2.0, 1.0)
+    wa.add(4.0, 3.0)
+    np.testing.assert_allclose(wa.eval(), (2.0 + 12.0) / 4.0)
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+
+
+def test_install_check_runs():
+    fluid.install_check.run_check()
+
+
+def test_net_drawer_dot_export():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 2, act="relu")
+    dot = fluid.net_drawer.program_to_dot(main)
+    assert dot.startswith("digraph") and dot.rstrip().endswith("}")
+    assert "mul" in dot and "relu" in dot and '"v_x"' in dot
+    # draw_graph parity signature
+    assert fluid.net_drawer.draw_graph(startup, main) == dot
+
+
+def test_extend_with_decoupled_weight_decay():
+    """AdamW = Adam + p -= coeff*p (decoupled; reference
+    contrib/extend_optimizer). One step from known init must equal the plain
+    Adam step minus the decay term."""
+    from paddle_tpu.contrib import extend_with_decoupled_weight_decay
+
+    def one_step(use_decay):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 0
+        startup.random_seed = 0
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [4], "float32")
+            y = fluid.layers.fc(x, 1, bias_attr=False,
+                                param_attr=fluid.ParamAttr(name="w"))
+            loss = fluid.layers.mean(y)
+            if use_decay:
+                AdamW = extend_with_decoupled_weight_decay(
+                    fluid.optimizer.AdamOptimizer)
+                AdamW(weight_decay=0.1, learning_rate=0.01).minimize(loss)
+            else:
+                fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            w0 = np.array(fluid.global_scope().find_var("w"))
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[])
+            w1 = np.array(fluid.global_scope().find_var("w"))
+        return w0, w1
+
+    w0p, w1p = one_step(False)
+    w0d, w1d = one_step(True)
+    np.testing.assert_allclose(w0p, w0d, rtol=1e-6)
+    # decayed = plain_step applied to (w0 - 0.1*w0): the decay subtracts
+    # BEFORE the optimizer update reads the param, but adam's step here only
+    # depends on the gradient, so w1d == w1p - 0.1*w0
+    np.testing.assert_allclose(w1d, w1p - 0.1 * w0p, rtol=1e-4, atol=1e-6)
+
+
+def test_minimize_grad_clip_kwarg():
+    """grad_clip= on minimize (the dygraph_grad_clip.py surface) caps the
+    update magnitude."""
+    def run(clip):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 0
+        startup.random_seed = 0
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [4], "float32")
+            y = fluid.layers.fc(x, 1, bias_attr=False,
+                                param_attr=fluid.ParamAttr(name="w"))
+            loss = fluid.layers.mean(y) * 1000.0  # huge gradient
+            fluid.optimizer.SGD(1.0).minimize(loss, grad_clip=clip)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            w0 = np.array(fluid.global_scope().find_var("w"))
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[])
+            w1 = np.array(fluid.global_scope().find_var("w"))
+        return np.abs(w1 - w0).max()
+
+    unclipped = run(None)
+    by_value = run(fluid.clip.GradientClipByValue(0.01))
+    by_gnorm = run(fluid.clip.GradientClipByGlobalNorm(0.01))
+    assert unclipped > 100
+    assert by_value <= 0.011
+    assert by_gnorm <= 0.011
