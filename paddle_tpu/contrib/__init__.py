@@ -1,4 +1,5 @@
 from . import mixed_precision  # noqa: F401
 from . import quantize  # noqa: F401
 from . import extend_optimizer  # noqa: F401
+from . import slim  # noqa: F401
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401

@@ -1,0 +1,233 @@
+"""Model slimming: pruning + distillation (reference contrib/slim/prune/
+pruner.py:22,34 StructurePruner, prune_strategy.py sensitive/uniform
+strategies, slim/distillation/distiller.py:25,103,195 L2/FSP/SoftLabel
+distillers; NAS/auto-prune orchestration is scoped out -- see SCOPE.md).
+
+TPU-first redesign: the reference prunes by walking the C++ graph and
+physically shrinking tensors per strategy epoch. Here pruning is a
+*mask rewrite on the Program* -- masks are persistable vars, a
+``param = param * mask`` op appended after the optimizer update keeps pruned
+weights at zero through finetuning (XLA folds the multiply into the update
+fusion), and masks ride checkpoints like any other persistable. Physical
+shrinking on TPU buys nothing until sparsity is structured at MXU tile
+granularity, so the structured pruner scores/zeroes whole output channels
+(the useful structure) without re-plumbing shapes.
+
+Distillers build loss terms with plain layers ops on the default program --
+merge teacher and student into one program (teacher vars stop_gradient) and
+add the distiller loss to the task loss.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import layers
+
+
+# --------------------------------------------------------------------------
+# pruners (reference slim/prune/pruner.py)
+# --------------------------------------------------------------------------
+
+class Pruner(object):
+    """Base class (reference pruner.py:22)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Group pruning by axis (reference pruner.py:34): ranks slices of a
+    parameter along ``pruning_axis`` by a criterion (l1_norm) and selects
+    the lowest-ratio fraction for removal/zeroing."""
+
+    def __init__(self, pruning_axis: Dict[str, int],
+                 criterions: Optional[Dict[str, str]] = None):
+        self.pruning_axis = dict(pruning_axis)
+        self.criterions = dict(criterions or {"*": "l1_norm"})
+
+    def _axis(self, name):
+        return self.pruning_axis.get(name, self.pruning_axis.get("*", 0))
+
+    def _criterion(self, name):
+        c = self.criterions.get(name, self.criterions.get("*", "l1_norm"))
+        if c != "l1_norm":
+            raise ValueError(f"criterion {c!r} not supported (l1_norm only, "
+                             f"as in the reference)")
+        return c
+
+    def cal_pruned_idx(self, name: str, param: np.ndarray, ratio: float,
+                       axis: Optional[int] = None) -> List[int]:
+        """Indices of the lowest-l1 slices along ``axis`` (reference
+        pruner.py:55)."""
+        axis = self._axis(name) if axis is None else axis
+        self._criterion(name)
+        reduce_axes = tuple(i for i in range(param.ndim) if i != axis)
+        scores = np.abs(param).sum(axis=reduce_axes)
+        n_prune = int(round(ratio * param.shape[axis]))
+        return np.argsort(scores)[:n_prune].tolist()
+
+    def prune_tensor(self, tensor: np.ndarray, pruned_idx: Sequence[int],
+                     pruned_axis: int, lazy: bool = False) -> np.ndarray:
+        """lazy=True zeroes the slices (mask pruning); lazy=False removes
+        them (reference pruner.py:81)."""
+        if lazy:
+            out = np.array(tensor)
+            sl = [slice(None)] * tensor.ndim
+            sl[pruned_axis] = list(pruned_idx)
+            out[tuple(sl)] = 0
+            return out
+        return np.delete(tensor, list(pruned_idx), axis=pruned_axis)
+
+
+# --------------------------------------------------------------------------
+# program-level pruning rewrite
+# --------------------------------------------------------------------------
+
+def _select_params(program, params):
+    block = program.global_block()
+    out = []
+    for name, v in block.vars.items():
+        if not getattr(v, "trainable", False):
+            continue
+        if params is None:
+            if len(v.shape) >= 2:   # weights, not biases/BN scales
+                out.append(v)
+        elif any(re.search(p, name) for p in params):
+            out.append(v)
+    return out
+
+
+def compute_magnitude_masks(scope, program, ratio: float,
+                            params: Optional[Sequence[str]] = None,
+                            structured_axis: Optional[int] = None):
+    """Host-side mask computation from current scope values.
+
+    ratio: fraction of weights (or of axis-slices when ``structured_axis``
+    is given) to zero, lowest |w| / l1 first. Returns {param_name: mask}.
+    """
+    masks = {}
+    pruner = StructurePruner({"*": structured_axis or 0})
+    for v in _select_params(program, params):
+        w = np.asarray(scope.find_var(v.name)).astype(np.float32)
+        if structured_axis is not None:
+            idx = pruner.cal_pruned_idx(v.name, w, ratio,
+                                        axis=structured_axis)
+            mask = np.ones_like(w)
+            sl = [slice(None)] * w.ndim
+            sl[structured_axis] = idx
+            mask[tuple(sl)] = 0
+        else:
+            k = int(ratio * w.size)
+            mask = np.ones(w.size, np.float32)
+            if k > 0:
+                mask[np.argsort(np.abs(w).reshape(-1))[:k]] = 0
+            mask = mask.reshape(w.shape)
+        masks[v.name] = mask
+    return masks
+
+
+def apply_pruning_masks(program, scope, masks: Dict[str, np.ndarray]):
+    """Rewrite ``program`` so every step re-applies the masks after the
+    optimizer update (param = param * mask), and zero the current values.
+
+    Masks become persistable non-trainable vars in the scope (saved by
+    save_persistables, so a pruned checkpoint stays pruned on resume).
+    """
+    block = program.global_block()
+    for name, mask in masks.items():
+        v = block.var(name)
+        mname = name + "@prune_mask"
+        mv = block.create_var(mname, tuple(v.shape), "float32")
+        mv.persistable = True
+        mv.stop_gradient = True
+        block.append_op("elementwise_mul",
+                        inputs={"X": [name], "Y": [mname]},
+                        outputs={"Out": [name]},
+                        attrs={"axis": -1}, infer_shape=False)
+        scope.set_var(mname, mask.astype(np.float32))
+        cur = np.asarray(scope.find_var(name))
+        scope.set_var(name, (cur * mask).astype(cur.dtype))
+    program._bump()
+
+
+def sparsity(scope, masks: Dict[str, np.ndarray]) -> float:
+    """Measured fraction of exactly-zero weights in the pruned params, read
+    from the live scope values -- detects a failed/undone mask rewrite
+    (weights that regrew), unlike counting mask zeros."""
+    z = t = 0
+    for name in masks:
+        w = np.asarray(scope.find_var(name))
+        z += (w == 0).sum()
+        t += w.size
+    return float(z) / max(t, 1)
+
+
+# --------------------------------------------------------------------------
+# distillers (reference slim/distillation/distiller.py)
+# --------------------------------------------------------------------------
+
+class L2Distiller(object):
+    """|| student_feature - teacher_feature ||^2 (reference distiller.py:25)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, student_var, teacher_var):
+        diff = layers.elementwise_sub(student_var,
+                                      _frozen(teacher_var))
+        return layers.reduce_mean(layers.square(diff)) * self.weight
+
+
+class FSPDistiller(object):
+    """Flow-of-solution-procedure distillation (reference distiller.py:103):
+    L2 between student and teacher FSP matrices of feature-map pairs."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, student_pairs_vars, teacher_pairs_vars):
+        losses = []
+        for (s0, s1), (t0, t1) in zip(student_pairs_vars,
+                                      teacher_pairs_vars):
+            s_fsp = layers.fsp_matrix(s0, s1)
+            t_fsp = layers.fsp_matrix(_frozen(t0), _frozen(t1))
+            losses.append(layers.reduce_mean(
+                layers.square(layers.elementwise_sub(s_fsp, t_fsp))))
+        total = losses[0]
+        for l in losses[1:]:
+            total = layers.elementwise_add(total, l)
+        return total * self.weight
+
+
+class SoftLabelDistiller(object):
+    """KL between temperature-softened logits (reference distiller.py:195)."""
+
+    def __init__(self, student_feature_map=None, teacher_feature_map=None,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, student_logits, teacher_logits):
+        s = layers.softmax(student_logits * (1.0 / self.student_temperature))
+        t = layers.softmax(
+            _frozen(teacher_logits) * (1.0 / self.teacher_temperature))
+        ce = layers.cross_entropy(s, t, soft_label=True)
+        return layers.reduce_mean(ce) * self.weight
+
+
+def _frozen(v):
+    """Teacher tensors contribute no gradients."""
+    out = layers.assign(v)
+    out.stop_gradient = True
+    return out

@@ -1,0 +1,156 @@
+"""contrib.slim pruning + distillation (reference contrib/slim/prune/
+pruner.py, slim/distillation/distiller.py; VERDICT r3 #4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import slim
+
+
+def _convnet(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 16, 16], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.conv2d(img, 16, 3, padding=1, act="relu")
+        h = fluid.layers.pool2d(h, 2, "max", 2)
+        h = fluid.layers.conv2d(h, 32, 3, padding=1, act="relu")
+        h = fluid.layers.pool2d(h, 2, "max", 2)
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _data(rng, n=64):
+    img = rng.rand(n, 3, 16, 16).astype("float32")
+    # learnable: label = brightness bucket
+    label = (img.mean(axis=(1, 2, 3)) * 10).astype("int64").clip(0, 9)[:, None]
+    return img, label
+
+
+def _steps(exe, main, loss, feed, k):
+    out = []
+    for _ in range(k):
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        out.append(float(np.asarray(lv).reshape(())))
+    return out
+
+
+def test_structure_pruner_idx_and_tensor():
+    p = slim.StructurePruner({"*": 0})
+    w = np.array([[1.0, 1.0], [0.1, 0.1], [5.0, 5.0], [0.2, 0.2]],
+                 "float32")
+    idx = p.cal_pruned_idx("w", w, 0.5)
+    assert sorted(idx) == [1, 3]          # two lowest-l1 rows
+    lazy = p.prune_tensor(w, idx, 0, lazy=True)
+    assert lazy.shape == w.shape and (lazy[1] == 0).all() \
+        and (lazy[3] == 0).all()
+    hard = p.prune_tensor(w, idx, 0, lazy=False)
+    assert hard.shape == (2, 2)
+    np.testing.assert_allclose(hard, w[[0, 2]])
+
+
+def test_magnitude_prune_then_finetune_recovers():
+    """The VERDICT r3 #4 contract: prune 50% -> loss jumps -> finetune
+    recovers while sparsity is preserved by the mask rewrite."""
+    rng = np.random.RandomState(0)
+    img, label = _data(rng)
+    feed = {"img": img, "label": label}
+    main, startup, loss = _convnet()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pre = _steps(exe, main, loss, feed, 40)
+        masks = slim.compute_magnitude_masks(scope, main, ratio=0.5)
+        assert {"conv2d_0.w_0", "conv2d_1.w_0", "fc_0.w_0"} <= set(masks)
+        slim.apply_pruning_masks(main, scope, masks)
+        assert abs(slim.sparsity(scope, masks) - 0.5) < 0.02
+        post_prune = _steps(exe, main, loss, feed, 1)[0]
+        fine = _steps(exe, main, loss, feed, 60)
+        # pruning hurt, finetuning recovered most of it
+        assert post_prune > pre[-1]
+        assert fine[-1] < post_prune * 0.7 or fine[-1] < pre[-1] * 1.1
+        # sparsity still holds after finetuning (the rewrite re-applies masks)
+        for name, mask in masks.items():
+            w = np.asarray(scope.find_var(name))
+            assert np.abs(w[np.asarray(mask) == 0]).max() == 0.0
+
+
+def test_structured_prune_zeroes_whole_filters():
+    rng = np.random.RandomState(1)
+    img, label = _data(rng)
+    main, startup, loss = _convnet(seed=6)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        _steps(exe, main, loss, {"img": img, "label": label}, 5)
+        masks = slim.compute_magnitude_masks(
+            scope, main, ratio=0.25, params=[r"conv2d_0\.w_0"],
+            structured_axis=0)
+        mask = masks["conv2d_0.w_0"]
+        per_filter = mask.reshape(mask.shape[0], -1)
+        zero_rows = (per_filter == 0).all(axis=1)
+        assert zero_rows.sum() == 4       # 25% of 16 filters, whole rows
+        slim.apply_pruning_masks(main, scope, masks)
+        _steps(exe, main, loss, {"img": img, "label": label}, 3)
+        w = np.asarray(scope.find_var("conv2d_0.w_0"))
+        assert np.abs(w[zero_rows]).max() == 0.0
+
+
+def test_distillers_build_and_teacher_frozen():
+    """L2 + soft-label distillation losses train the student only."""
+    rng = np.random.RandomState(2)
+    x_np = rng.randn(32, 8).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    startup.random_seed = 1
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [8], "float32")
+        teacher = fluid.layers.fc(
+            x, 4, param_attr=fluid.ParamAttr(name="teacher_w"))
+        student = fluid.layers.fc(
+            x, 4, param_attr=fluid.ParamAttr(name="student_w"))
+        l2 = slim.L2Distiller("student", "teacher").distiller_loss(
+            student, teacher)
+        soft = slim.SoftLabelDistiller(
+            student_temperature=2.0,
+            teacher_temperature=2.0).distiller_loss(student, teacher)
+        total = fluid.layers.elementwise_add(l2, soft)
+        fluid.optimizer.SGD(0.2).minimize(total)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        tw0 = np.array(fluid.global_scope().find_var("teacher_w"))
+        sw0 = np.array(fluid.global_scope().find_var("student_w"))
+        losses = _steps(exe, main, total, {"x": x_np}, 30)
+        tw1 = np.array(fluid.global_scope().find_var("teacher_w"))
+        sw1 = np.array(fluid.global_scope().find_var("student_w"))
+    assert losses[-1] < losses[0] * 0.5          # student learns the teacher
+    np.testing.assert_array_equal(tw0, tw1)      # teacher frozen
+    assert np.abs(sw1 - sw0).max() > 1e-4        # student moved
+
+
+def test_fsp_distiller_builds():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 8, 8], "float32")
+        s0 = fluid.layers.conv2d(img, 4, 3, padding=1)
+        s1 = fluid.layers.conv2d(s0, 4, 3, padding=1)
+        t0 = fluid.layers.conv2d(img, 4, 3, padding=1)
+        t1 = fluid.layers.conv2d(t0, 4, 3, padding=1)
+        loss = slim.FSPDistiller(
+            [("s0", "s1")], [("t0", "t1")]).distiller_loss(
+            [(s0, s1)], [(t0, t1)])
+    exe = fluid.Executor()
+    rng = np.random.RandomState(3)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lv, = exe.run(main, feed={"img": rng.randn(2, 3, 8, 8)
+                                  .astype("float32")}, fetch_list=[loss])
+    assert np.isfinite(np.asarray(lv)).all()
