@@ -1,0 +1,62 @@
+"""Linear regression on UCI housing (reference tests/book/test_fit_a_line.py
+-- the first book chapter). Trains fc(1) with SGD to a small MSE and runs the
+saved inference model through the Predictor."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dataset import uci_housing
+
+
+def main():
+    xs, ys = [], []
+    for x, y in uci_housing.train()():
+        xs.append(np.asarray(x, "float32"))
+        ys.append(np.asarray(y, "float32"))
+    X, Y = np.stack(xs), np.stack(ys).reshape(-1, 1)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
+        x = fluid.data("x", [13], "float32")
+        y = fluid.data("y", [1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    bs = 64
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for ep in range(30):
+            losses = []
+            for i in range(0, len(X) - bs + 1, bs):
+                lv, = exe.run(main_prog,
+                              feed={"x": X[i:i + bs], "y": Y[i:i + bs]},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(())))
+            if ep % 10 == 0 or ep == 29:
+                print(f"epoch {ep}: mse={np.mean(losses):.4f}")
+        final = float(np.mean(losses))
+
+        # chapter epilogue: save + serve the inference model
+        path = "/tmp/fit_a_line_model"
+        fluid.io.save_inference_model(path, ["x"], [pred], exe,
+                                      main_program=main_prog)
+        from paddle_tpu.inference import Predictor
+        p = Predictor(path)
+        out = p.run({"x": X[:4]})[0]
+        print("sample predictions:", np.asarray(out).reshape(-1)[:4],
+              "targets:", Y[:4].reshape(-1))
+    assert final < 30.0, f"fit_a_line did not converge (mse={final})"
+    print(f"fit_a_line OK, final mse={final:.4f}")
+
+
+if __name__ == "__main__":
+    main()

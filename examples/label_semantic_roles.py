@@ -1,0 +1,115 @@
+"""Semantic role labeling with a CRF head (reference
+tests/book/test_label_semantic_roles.py): 8 feature embeddings -> stacked
+bidirectional LSTM -> linear_chain_crf loss, crf_decoding for inference.
+Exercises dynamic_lstm + linear_chain_crf at model scale on padded+lengths
+sequences. Data: paddle_tpu.dataset.conll05 (synthetic SRL corpus unless a
+real cache exists)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dataset import conll05
+
+MAX_LEN = 20
+EMB = 32
+HID = 64       # 4 * lstm hidden
+DEPTH = 2      # stacked bi-lstm pairs (the book uses 8)
+
+
+def load(limit=512):
+    feats, lens, labels = [], [], []
+    for slots in conll05.test()():
+        *feat8, lab = slots
+        n = min(len(lab), MAX_LEN)
+        pad = lambda xs: list(xs[:n]) + [0] * (MAX_LEN - n)
+        feats.append([pad(f) for f in feat8])
+        labels.append(pad(lab))
+        lens.append(n)
+        if len(feats) >= limit:
+            break
+    return (np.array(feats, "int64"),          # [N, 8, T]
+            np.array(lens, "int64"), np.array(labels, "int64"))
+
+
+def build(n_words, n_verbs, n_labels):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        names = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+                 "verb", "mark"]
+        feats = [fluid.data(n, [-1, MAX_LEN], "int64", **A) for n in names]
+        length = fluid.data("length", [-1], "int64", **A)
+        label = fluid.data("label", [-1, MAX_LEN], "int64", **A)
+
+        vocab_of = dict(word=n_words, ctx_n2=n_words, ctx_n1=n_words,
+                        ctx_0=n_words, ctx_p1=n_words, ctx_p2=n_words,
+                        verb=n_verbs, mark=2)
+        embs = [fluid.layers.embedding(f, [vocab_of[n], EMB])
+                for n, f in zip(names, feats)]
+        merged = fluid.layers.sum(embs)
+
+        # stacked bidirectional LSTM (the book's interleaved fwd/rev stack)
+        h = fluid.layers.fc(merged, HID, num_flatten_dims=2)
+        for d in range(DEPTH):
+            fwd, _ = fluid.layers.dynamic_lstm(h, HID, length=length)
+            rev, _ = fluid.layers.dynamic_lstm(h, HID, length=length,
+                                               is_reverse=True)
+            both = fluid.layers.concat([fwd, rev], axis=2)
+            h = fluid.layers.fc(both, HID, num_flatten_dims=2)
+        emission = fluid.layers.fc(h, n_labels, num_flatten_dims=2)
+
+        crf_attr = fluid.ParamAttr(name="crfw")
+        # linear_chain_crf returns the negative log-likelihood directly
+        # (reference kernel convention) -- minimize it as-is
+        nll = fluid.layers.linear_chain_crf(emission, label,
+                                            param_attr=crf_attr,
+                                            length=length)
+        loss = fluid.layers.mean(nll)
+        path = fluid.layers.crf_decoding(emission, crf_attr, length=length)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    return main, startup, names, loss, path
+
+
+def main():
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    feats, lens, labels = load()
+    main_prog, startup, names, loss, path = build(
+        len(word_dict), len(verb_dict), len(label_dict))
+    exe = fluid.Executor()
+    bs = 64
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for ep in range(8):
+            losses = []
+            for i in range(0, len(feats) - bs + 1, bs):
+                feed = {n: feats[i:i + bs, j] for j, n in enumerate(names)}
+                feed["length"] = lens[i:i + bs]
+                feed["label"] = labels[i:i + bs]
+                lv, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(())))
+            print(f"epoch {ep}: nll={np.mean(losses):.4f}")
+        # token accuracy of the viterbi decode on the first batch
+        feed = {n: feats[:bs, j] for j, n in enumerate(names)}
+        feed["length"] = lens[:bs]
+        feed["label"] = labels[:bs]
+        pv, = exe.run(main_prog, feed=feed, fetch_list=[path],
+                      use_prune=True)
+        pv = np.asarray(pv)
+        correct = total = 0
+        for b in range(bs):
+            n = lens[b]
+            correct += (pv[b, :n] == labels[b, :n]).sum()
+            total += n
+        acc = correct / total
+    print(f"viterbi token accuracy: {acc:.3f}")
+    assert acc > 0.9, f"SRL CRF did not learn ({acc})"
+
+
+if __name__ == "__main__":
+    main()

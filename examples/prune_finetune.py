@@ -57,12 +57,14 @@ def build():
     return main, startup, loss, acc
 
 
-def epoch(exe, main, loss, acc, imgs, labels, bs=64):
+def epoch(exe, main, loss, acc, imgs, labels, bs=64, train=True):
+    """train=True runs the full program (incl. the optimizer update);
+    train=False prunes to the fetches, so it only evaluates."""
     losses, accs = [], []
     for i in range(0, len(imgs) - bs + 1, bs):
         lv, av = exe.run(main, feed={"img": imgs[i:i + bs],
                                      "label": labels[i:i + bs]},
-                         fetch_list=[loss, acc])
+                         fetch_list=[loss, acc], use_prune=not train)
         losses.append(float(np.asarray(lv).reshape(())))
         accs.append(float(np.asarray(av).reshape(-1)[0]))
     return float(np.mean(losses)), float(np.mean(accs))
@@ -79,12 +81,14 @@ def main():
             l, a = epoch(exe, main_prog, loss, acc, imgs, labels)
             print(f"train epoch {ep}: loss={l:.4f} acc={a:.3f}")
 
+        l, a = epoch(exe, main_prog, loss, acc, imgs, labels, train=False)
+        print(f"before pruning (eval): loss={l:.4f} acc={a:.3f}")
         masks = slim.compute_magnitude_masks(scope, main_prog, ratio=0.5)
         slim.apply_pruning_masks(main_prog, scope, masks)
         print(f"pruned 50% of weights "
               f"(sparsity={slim.sparsity(scope, masks):.2f})")
-        l, a = epoch(exe, main_prog, loss, acc, imgs, labels)
-        print(f"right after pruning: loss={l:.4f} acc={a:.3f}")
+        l, a = epoch(exe, main_prog, loss, acc, imgs, labels, train=False)
+        print(f"right after pruning (eval): loss={l:.4f} acc={a:.3f}")
 
         for ep in range(4):
             l, a = epoch(exe, main_prog, loss, acc, imgs, labels)

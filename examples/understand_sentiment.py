@@ -1,0 +1,96 @@
+"""Sentiment classification with a pooled dynamic LSTM on IMDB (reference
+tests/book/notest_understand_sentiment.py stacked-LSTM chapter).
+
+Exercises the padded+lengths sequence stack at model scale: embedding ->
+fc(4H) -> dynamic_lstm(length) -> sequence_pool(max, length) -> softmax.
+Data comes from paddle_tpu.dataset.imdb (real aclImdb if cached, else the
+synthetic sentiment corpus).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dataset import imdb
+
+MAX_LEN = 96
+HID = 64
+EMB = 64
+
+
+def load(word_idx, split, limit):
+    reader = (imdb.train if split == "train" else imdb.test)(word_idx)
+    ids, lens, labels = [], [], []
+    for words, label in reader():
+        words = words[:MAX_LEN]
+        lens.append(len(words))
+        ids.append(words + [0] * (MAX_LEN - len(words)))
+        labels.append(label)
+        if len(ids) >= limit:
+            break
+    return (np.array(ids, "int64"), np.array(lens, "int64"),
+            np.array(labels, "int64")[:, None])
+
+
+def build(vocab):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        data = fluid.data("words", [-1, MAX_LEN], "int64", **A)
+        length = fluid.data("length", [-1], "int64", **A)
+        label = fluid.data("label", [-1, 1], "int64", **A)
+        emb = fluid.layers.embedding(data, [vocab, EMB])
+        proj = fluid.layers.fc(emb, HID * 4, num_flatten_dims=2)
+        h, _ = fluid.layers.dynamic_lstm(proj, HID * 4, length=length)
+        pooled = fluid.layers.sequence_pool(h, "max", length=length)
+        logits = fluid.layers.fc(pooled, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(logits, label)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+    return main, startup, loss, acc
+
+
+def main():
+    word_idx = imdb.word_dict()
+    vocab = len(word_idx)
+    ids, lens, labels = load(word_idx, "train", 1024)
+    tids, tlens, tlabels = load(word_idx, "test", 256)
+    print(f"vocab={vocab}, train={len(ids)}, test={len(tids)}")
+
+    main_prog, startup, loss, acc = build(vocab)
+    exe = fluid.Executor()
+    bs = 64
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for ep in range(6):
+            losses = []
+            for i in range(0, len(ids) - bs + 1, bs):
+                lv, _ = exe.run(main_prog,
+                                feed={"words": ids[i:i + bs],
+                                      "length": lens[i:i + bs],
+                                      "label": labels[i:i + bs]},
+                                fetch_list=[loss, acc])
+                losses.append(float(np.asarray(lv).reshape(())))
+            print(f"epoch {ep}: loss={np.mean(losses):.4f}")
+        # eval (prune to fetches so the optimizer does not run)
+        accs = []
+        for i in range(0, len(tids) - bs + 1, bs):
+            _, av = exe.run(main_prog,
+                            feed={"words": tids[i:i + bs],
+                                  "length": tlens[i:i + bs],
+                                  "label": tlabels[i:i + bs]},
+                            fetch_list=[loss, acc], use_prune=True)
+            accs.append(float(np.asarray(av).reshape(-1)[0]))
+        test_acc = float(np.mean(accs))
+    print(f"test accuracy: {test_acc:.3f}")
+    assert test_acc > 0.8, f"sentiment LSTM did not learn ({test_acc})"
+
+
+if __name__ == "__main__":
+    main()

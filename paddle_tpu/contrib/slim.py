@@ -115,10 +115,8 @@ def compute_magnitude_masks(scope, program, ratio: float,
         if structured_axis is not None:
             idx = pruner.cal_pruned_idx(v.name, w, ratio,
                                         axis=structured_axis)
-            mask = np.ones_like(w)
-            sl = [slice(None)] * w.ndim
-            sl[structured_axis] = idx
-            mask[tuple(sl)] = 0
+            mask = pruner.prune_tensor(np.ones_like(w), idx,
+                                       structured_axis, lazy=True)
         else:
             k = int(ratio * w.size)
             mask = np.ones(w.size, np.float32)
@@ -140,6 +138,11 @@ def apply_pruning_masks(program, scope, masks: Dict[str, np.ndarray]):
     for name, mask in masks.items():
         v = block.var(name)
         mname = name + "@prune_mask"
+        if mname in block.vars:
+            raise ValueError(
+                f"{name} already has pruning masks applied (the rewrite is "
+                f"not idempotent); to change masks, update the "
+                f"'{mname}' scope value instead of re-applying")
         mv = block.create_var(mname, tuple(v.shape), "float32")
         mv.persistable = True
         mv.stop_gradient = True
@@ -170,9 +173,13 @@ def sparsity(scope, masks: Dict[str, np.ndarray]) -> float:
 # --------------------------------------------------------------------------
 
 class L2Distiller(object):
-    """|| student_feature - teacher_feature ||^2 (reference distiller.py:25)."""
+    """|| student_feature - teacher_feature ||^2 (reference distiller.py:25).
 
-    def __init__(self, student_feature_map, teacher_feature_map,
+    The *_feature_map name args are reference-surface compat only: the
+    reference resolved vars by name from its graph; here distiller_loss
+    takes the Variables explicitly."""
+
+    def __init__(self, student_feature_map=None, teacher_feature_map=None,
                  distillation_loss_weight=1.0):
         self.student_feature_map = student_feature_map
         self.teacher_feature_map = teacher_feature_map
@@ -214,6 +221,9 @@ class SoftLabelDistiller(object):
     def __init__(self, student_feature_map=None, teacher_feature_map=None,
                  student_temperature=1.0, teacher_temperature=1.0,
                  distillation_loss_weight=1.0):
+        # name args: reference-surface compat only (see L2Distiller)
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
         self.student_temperature = student_temperature
         self.teacher_temperature = teacher_temperature
         self.weight = distillation_loss_weight

@@ -22,8 +22,10 @@ import warnings
 from . import mnist  # noqa: F401
 from . import cifar  # noqa: F401
 from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import conll05  # noqa: F401
 
-__all__ = ["mnist", "cifar", "uci_housing", "data_home"]
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "conll05", "data_home"]
 
 
 def data_home(name: str) -> str:

