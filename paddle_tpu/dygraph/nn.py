@@ -485,3 +485,121 @@ class GRUUnit(Layer):
         # reference gru_unit_op.h stores the ACTIVATED gates in Gate
         gate = op("concat", {"X": [u, r, c]}, {"axis": 1})
         return nh, rh, gate
+
+
+class NCE(Layer):
+    """Reference dygraph/nn.py:1840 NCE: noise-contrastive estimation head
+    over the registry's nce op (uniform negative sampler + logQ correction)."""
+
+    def __init__(self, num_total_classes, dim, num_neg_samples=10,
+                 param_attr=None, bias_attr=None, sampler="uniform",
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        if sampler != "uniform":
+            raise NotImplementedError(
+                "custom_dist/log_uniform samplers: the op draws uniform "
+                "negatives (reference default)")
+        self._attrs = {"num_total_classes": int(num_total_classes),
+                       "num_neg_samples": int(num_neg_samples)}
+        self.weight = self.create_parameter([num_total_classes, dim])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([num_total_classes],
+                                                is_bias=True))
+
+    def forward(self, input, label):
+        ins = {"Input": [input], "Label": [label], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return trace_op("nce", ins, self._attrs, ["Cost"])["Cost"][0]
+
+
+class SequenceConv(Layer):
+    """Reference dygraph/nn.py:2557 SequenceConv: context-window projection
+    over padded [B, T, D] sequences (+ optional length masking)."""
+
+    def __init__(self, num_filters, filter_size=3, filter_stride=1,
+                 padding=True, input_dim=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if input_dim is None:
+            raise ValueError("pass input_dim (the reference inferred it on "
+                             "first forward; explicit is simpler)")
+        self._attrs = {"context_length": int(filter_size),
+                       "context_start": -((int(filter_size) - 1) // 2)}
+        self.filter = self.create_parameter(
+            [int(filter_size) * int(input_dim), num_filters])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([num_filters], is_bias=True))
+        self._act = act
+
+    def forward(self, x, length=None):
+        ins = {"X": [x], "Filter": [self.filter]}
+        if length is not None:
+            ins["Length"] = [length]
+        out = trace_op("sequence_conv", ins, self._attrs, ["Out"])["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": -1}, ["Out"])["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out
+
+
+class SpectralNorm(Layer):
+    """Reference dygraph/nn.py:2830 SpectralNorm: weight / sigma_max via
+    power iteration; the U/V iteration vectors persist across calls."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"dim": int(dim), "power_iters": int(power_iters),
+                       "eps": float(eps)}
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        self.weight_u = self.create_parameter([h], initializer="normal")
+        self.weight_v = self.create_parameter([w], initializer="normal")
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        outs = trace_op("spectral_norm",
+                        {"Weight": [weight], "U": [self.weight_u],
+                         "V": [self.weight_v]},
+                        self._attrs, ["Out", "UOut", "VOut"])
+        self.weight_u.value = outs["UOut"][0].value
+        self.weight_v.value = outs["VOut"][0].value
+        return outs["Out"][0]
+
+
+class TreeConv(Layer):
+    """Reference dygraph/nn.py:2930 TreeConv: tree-based convolution
+    (TBCNN) over the registry's tree_conv op."""
+
+    def __init__(self, feature_size, output_size, num_filters=1, max_depth=2,
+                 act="tanh", param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"max_depth": int(max_depth)}
+        self.filter = self.create_parameter(
+            [int(feature_size), 3, int(output_size), int(num_filters)])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([int(num_filters)],
+                                                is_bias=True))
+        self._act = act
+
+    def forward(self, nodes_vector, edge_set):
+        out = trace_op("tree_conv",
+                       {"NodesVector": [nodes_vector],
+                        "EdgeSet": [edge_set], "Filter": [self.filter]},
+                       self._attrs, ["Out"])["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": -1}, ["Out"])["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])["Out"][0]
+        return out

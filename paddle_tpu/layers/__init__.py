@@ -22,7 +22,7 @@ from .extras import (maxout, lrn, pixel_shuffle, shuffle_channel,  # noqa
                      space_to_depth, temporal_shift, unfold, affine_channel,
                      bilinear_tensor_product, add_position_encoding,
                      multiplex, crop, crop_tensor, pad_constant_like,
-                     shard_index, fsp_matrix, row_conv,
+                     shard_index, fsp_matrix, row_conv, tree_conv,
                      uniform_random_batch_size_like,
                      gaussian_random_batch_size_like, selu, mean_iou,
                      rank_loss, margin_rank_loss, bpr_loss, kldiv_loss,

@@ -548,8 +548,9 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
     """Reference detection.py:generate_proposal_labels (second-stage target
     assignment). Fixed-shape TPU form: all R+G rows kept with ClsWeights
     carrying the sampled fg/bg proportions (use_random accepted and
-    ignored); returns a 6-tuple — the reference's 5 outputs plus the
-    per-roi classification weights.
+    ignored); returns a 7-tuple — the reference's 5 outputs plus the
+    per-roi classification weights and MatchedGt (the labeler's own
+    argmax-IoU gt index, for mask-target generation).
 
     rpn_rois [N,R,4]; gt_classes [N,G]; is_crowd [N,G] or None;
     gt_boxes [N,G,4]; im_info [N,3]; rpn_rois_num [N] masks proposal
@@ -568,6 +569,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
     tgt = _out(helper, "float32", stop_gradient=True)
     inw = _out(helper, "float32", stop_gradient=True)
     outw = _out(helper, "float32", stop_gradient=True)
+    matched = _out(helper, "int32", stop_gradient=True)
     inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
               "GtBoxes": [gt_boxes], "ImInfo": [im_info]}
     if is_crowd is not None:
@@ -578,7 +580,8 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
                      outputs={"Rois": [rois], "LabelsInt32": [labels],
                               "ClsWeights": [cls_w], "BboxTargets": [tgt],
                               "BboxInsideWeights": [inw],
-                              "BboxOutsideWeights": [outw]},
+                              "BboxOutsideWeights": [outw],
+                              "MatchedGt": [matched]},
                      attrs={"batch_size_per_im": int(batch_size_per_im),
                             "fg_fraction": float(fg_fraction),
                             "fg_thresh": float(fg_thresh),
@@ -589,7 +592,8 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
                             "class_nums": C})
     blk = helper.main_program.current_block()
     return (blk.var(rois.name), blk.var(labels.name), blk.var(tgt.name),
-            blk.var(inw.name), blk.var(outw.name), blk.var(cls_w.name))
+            blk.var(inw.name), blk.var(outw.name), blk.var(cls_w.name),
+            blk.var(matched.name))
 
 
 def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,

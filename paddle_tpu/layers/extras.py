@@ -1040,3 +1040,31 @@ def host_embedding(input, size, name, optimizer="adagrad", learning_rate=0.05,
                      outputs={"Out": [out]},
                      attrs={"table_name": name, "dtype": dtype})
     return _var(helper, out)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
+              act="tanh", param_attr=None, bias_attr=None, name=None):
+    """Reference nn.py:tree_conv (TBCNN, tree_conv_op.cc). nodes_vector
+    [B, N, F] (or [N, F]), edge_set [B, E, 2] 1-indexed parent->child pairs
+    ((0,0) = padding). Returns [B, N, output_size, num_filters]."""
+    helper = LayerHelper("tree_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    F = int(nodes_vector.shape[-1])
+    filt = helper.create_parameter(
+        param_attr, [F, 3, int(output_size), int(num_filters)],
+        nodes_vector.dtype)
+    out = _out(helper, nodes_vector.dtype)
+    helper.append_op("tree_conv",
+                     inputs={"NodesVector": [nodes_vector],
+                             "EdgeSet": [edge_set], "Filter": [filt]},
+                     outputs={"Out": [out]},
+                     attrs={"max_depth": int(max_depth)})
+    pre = _var(helper, out)
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [int(num_filters)],
+                                    nodes_vector.dtype, is_bias=True)
+        out2 = _out(helper, nodes_vector.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [pre], "Y": [b]},
+                         outputs={"Out": [out2]}, attrs={"axis": -1})
+        pre = _var(helper, out2)
+    return helper.append_activation(pre)

@@ -40,7 +40,15 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     inputs = input if isinstance(input, (list, tuple)) else [input]
     mul_results = []
     for x in inputs:
-        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        tail = tuple(x.shape[num_flatten_dims:])
+        if any(d < 0 for d in tail):
+            raise ValueError(
+                f"fc: input {getattr(x, 'name', '?')} has a dynamic dim in "
+                f"the flattened tail {tail} (num_flatten_dims="
+                f"{num_flatten_dims}); the weight shape would be wrong -- "
+                f"only dims before num_flatten_dims may be -1 (reference "
+                f"fc infer_shape enforces the same)")
+        in_features = int(np.prod(tail))
         w = helper.create_parameter(param_attr, [in_features, size], x.dtype)
         out = _out(helper, x.dtype)
         helper.append_op("mul", inputs={"X": [x], "Y": [w]},
@@ -498,6 +506,19 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
     if return_softmax:
         return _var(helper, loss), _var(helper, softmax_out)
     return _var(helper, loss)
+
+
+def cross_entropy2(input, label, ignore_index=-100):
+    """Reference nn.py:1917 -- hard-label CE variant whose kernel saves the
+    matched probability (MatchX) for its grad."""
+    helper = LayerHelper("cross_entropy2")
+    out = _out(helper, input.dtype)
+    match_x = _out(helper, input.dtype, stop_gradient=True)
+    helper.append_op("cross_entropy2",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out], "MatchX": [match_x]},
+                     attrs={"ignore_index": ignore_index})
+    return _var(helper, out)
 
 
 def cross_entropy(input, label, soft_label=False, ignore_index=-100):

@@ -120,7 +120,7 @@ def faster_rcnn(img, gt_box, gt_label, im_info, batch_size, num_classes=81,
         pre_nms_top_n=256, post_nms_top_n=post_nms_top_n, nms_thresh=0.7,
         min_size=4.0)
     (s_rois, s_labels, s_tgt, s_inw, s_outw,
-     s_clsw) = layers.generate_proposal_labels(
+     s_clsw, _matched) = layers.generate_proposal_labels(
         rois, gt_label, is_crowd, gt_box, im_info, class_nums=num_classes,
         fg_thresh=0.5, rpn_rois_num=rois_num)
 

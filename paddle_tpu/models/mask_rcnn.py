@@ -44,9 +44,16 @@ def _fpn_backbone(img, scale=1.0, blocks_per_stage=1, n_stages=4,
     return feats
 
 
-def _fpn_neck(feats, out_ch, min_level=2):
+def _fpn_neck(feats, out_ch, base_stride=4):
     """Lateral 1x1 + top-down nearest upsample + 3x3 smooth -> P_levels,
-    finest first. Returns ([P2, P3, ...], [stride2, stride3, ...])."""
+    finest first. Returns ([P2, P3, ...], [stride2, stride3, ...]).
+
+    Strides are derived from the actual geometry of ``feats``: the backbone
+    yields stride base_stride (4: stem/2 + pool/2) for its first feature and
+    doubles per stage -- callers wanting coarser minimum levels slice
+    ``feats`` and pass the matching base_stride (there is deliberately no
+    relabeling knob: a relabeled level desyncs anchor placement from the
+    feature grid, the advisor-r3 retinanet bug)."""
     laterals = [layers.conv2d(f, out_ch, 1,
                               param_attr=ParamAttr(name=f"fpn_lat{i}.w"))
                 for i, f in enumerate(feats)]
@@ -60,7 +67,7 @@ def _fpn_neck(feats, out_ch, min_level=2):
     smoothed = [layers.conv2d(p, out_ch, 3, padding=1,
                               param_attr=ParamAttr(name=f"fpn_smooth{i}.w"))
                 for i, p in enumerate(outs)]
-    strides = [2 ** (min_level + i) for i in range(len(feats))]
+    strides = [base_stride * 2 ** i for i in range(len(feats))]
     return smoothed, strides
 
 
@@ -117,7 +124,7 @@ def mask_rcnn(img, gt_box, gt_label, gt_masks, im_info, batch_size,
     min_level = 2
     H, W = img.shape[2], img.shape[3]
     feats = _fpn_backbone(img, scale, n_stages=levels)
-    pyramid, strides = _fpn_neck(feats, max(16, int(256 * scale)), min_level)
+    pyramid, strides = _fpn_neck(feats, max(16, int(256 * scale)))
     n_anchors = 3
 
     # ---- RPN over every level (shared weights via fixed param names) ----
@@ -170,7 +177,7 @@ def mask_rcnn(img, gt_box, gt_label, gt_masks, im_info, batch_size,
         lvl_rois, lvl_scores, min_level, min_level + levels - 1,
         post_nms_top_n)
     (s_rois, s_labels, s_tgt, s_inw, s_outw,
-     s_clsw) = layers.generate_proposal_labels(
+     s_clsw, s_matched) = layers.generate_proposal_labels(
         rois, gt_label, None, gt_box, im_info, class_nums=num_classes,
         fg_thresh=0.5, rpn_rois_num=rois_num)
 
@@ -196,11 +203,12 @@ def mask_rcnn(img, gt_box, gt_label, gt_masks, im_info, batch_size,
     box_loss = layers.elementwise_add(cls_loss, reg_loss)
 
     # ---- mask branch -----------------------------------------------------
-    # fg selector + matched gt from the roi/gt IoU (recomputed cheaply on
-    # the labeled rois: matched = argmax IoU, the same rule the labeler used)
+    # fg selector; the matched gt comes from the labeler itself (its
+    # crowd/zero-area-masked argmax-IoU), so a fg roi's mask target can
+    # never come from a different gt than its class label (advisor r3)
     fg = layers.cast(layers.greater_than(
         s_labels, layers.fill_constant([1], "int32", 0)), "float32")
-    matched = _match_rois_to_gt(s_rois, gt_box)
+    matched = s_matched
     mask_feat = _fpn_roi_align(pyramid, strides, flat_rois, flat_lvl, counts,
                                mask_resolution, min_level)
     mask_logits = _mask_head(mask_feat, num_classes, scale)  # [N*Rp,C,2m,2m]
@@ -226,19 +234,6 @@ def mask_rcnn(img, gt_box, gt_label, gt_masks, im_info, batch_size,
     return total, rpn_loss, box_loss, mask_loss
 
 
-def _match_rois_to_gt(rois, gt_box):
-    """argmax-IoU gt index per roi (the labeler's matching rule), [N, R]."""
-    N = rois.shape[0]
-    out = []
-    for i in range(N):
-        r = layers.reshape(layers.slice(rois, [0], [i], [i + 1]), [-1, 4])
-        g = layers.reshape(layers.slice(gt_box, [0], [i], [i + 1]), [-1, 4])
-        iou = layers.iou_similarity(r, g)              # [R, G]
-        out.append(layers.reshape(
-            layers.cast(layers.argmax(iou, axis=1), "int32"), [1, -1]))
-    return layers.concat(out, axis=0)
-
-
 def mask_rcnn_infer(img, im_info, batch_size, num_classes=81, scale=1.0,
                     levels=3, anchor_base=16, post_nms_top_n=64,
                     roi_resolution=7, mask_resolution=14, score_thresh=0.05,
@@ -248,7 +243,7 @@ def mask_rcnn_infer(img, im_info, batch_size, num_classes=81, scale=1.0,
     masks [N, K, 2*mask_resolution, 2*mask_resolution] probabilities)."""
     min_level = 2
     feats = _fpn_backbone(img, scale, n_stages=levels, is_test=True)
-    pyramid, strides = _fpn_neck(feats, max(16, int(256 * scale)), min_level)
+    pyramid, strides = _fpn_neck(feats, max(16, int(256 * scale)))
     n_anchors = 3
     lvl_rois, lvl_scores = [], []
     for li, (feat, stride) in enumerate(zip(pyramid, strides)):

@@ -59,10 +59,12 @@ def retinanet(img, gt_box, gt_label, im_info, batch_size, num_classes=81,
     """Training graph. gt_label classes are 1..C-1 (0 = background).
     Returns (total, cls_loss, reg_loss). Note: the class subnet predicts
     C-1 foreground channels (reference convention)."""
-    min_level = 3  # stride 8 first: keeps anchor counts sane
-    feats = _fpn_backbone(img, scale, n_stages=levels)
+    # start at the true stride-8 stage: drop the backbone's stride-4 feature
+    # and derive strides from the remaining geometry (a relabeled min_level
+    # desynced anchors from features -- advisor finding r3)
+    feats = _fpn_backbone(img, scale, n_stages=levels + 1)[1:]
     pyramid, strides = _fpn_neck(feats, max(16, int(256 * scale)),
-                                 min_level)
+                                 base_stride=8)
     n_anchors = 3
     level_outs = _level_outputs(pyramid, strides, num_classes, n_anchors,
                                 scale, n_convs)
@@ -82,10 +84,11 @@ def retinanet(img, gt_box, gt_label, im_info, batch_size, num_classes=81,
                                   [-1, 4])
             lbl_i = layers.reshape(layers.slice(gt_label, [0], [i], [i + 1]),
                                    [-1])
+            im_info_i = layers.slice(im_info, [0], [i], [i + 1])
             (sp, lp, st, lt, iw, fg) = layers.retinanet_target_assign(
                 box_i, cls_i, flat_anchors,
                 layers.reshape(variances, [-1, 4]), gt_i, lbl_i,
-                num_classes=num_classes)
+                im_info=im_info_i, num_classes=num_classes)
             cls_losses.append(layers.reduce_sum(
                 layers.sigmoid_focal_loss(sp, st, fg, gamma=gamma,
                                           alpha=alpha)))
@@ -105,10 +108,9 @@ def retinanet_infer(img, im_info, batch_size, num_classes=81, scale=1.0,
     """Inference: per-level decode vs anchors -> retinanet_detection_output.
     Returns dets [N, keep_top_k, 6] (label=-1 marks padding rows, the
     reference's empty-LoD analog)."""
-    min_level = 3
-    feats = _fpn_backbone(img, scale, n_stages=levels, is_test=True)
+    feats = _fpn_backbone(img, scale, n_stages=levels + 1, is_test=True)[1:]
     pyramid, strides = _fpn_neck(feats, max(16, int(256 * scale)),
-                                 min_level)
+                                 base_stride=8)
     n_anchors = 3
     level_outs = _level_outputs(pyramid, strides, num_classes, n_anchors,
                                 scale, n_convs)

@@ -1,7 +1,14 @@
 """Detection ops (reference: paddle/fluid/operators/detection/, 15.4k LoC).
 
-Round-1 subset: box_coder, prior_box, yolo_box, iou_similarity. The NMS family needs
-dynamic shapes; a TPU-friendly fixed-size top-k NMS is planned (see SURVEY.md §2.4).
+22 registered ops in fixed-shape TPU forms: the box family (box_coder,
+prior_box, yolo_box, iou_similarity, box_clip, anchor_generator), the NMS
+family (multiclass_nms/nms2 with kept-box Index), RoI ops (roi_align,
+roi_pool, collect/distribute_fpn_proposals), proposal/target machinery
+(generate_proposals, rpn_target_assign, generate_proposal_labels,
+generate_mask_targets, retinanet_target_assign, target_assign,
+bipartite_match), and losses/decodes (ssd_loss, sigmoid_focal_loss,
+yolov3_loss, detection_output). Dynamic result counts become fixed-size
+top-k + validity masks/indices (see SCOPE.md detection row).
 """
 from __future__ import annotations
 
@@ -902,7 +909,8 @@ def generate_proposal_labels(ctx, ins):
         inw = jnp.repeat(onehot, 4, axis=1).reshape(Rp, 4 * C)
         outw = inw * cls_w[:, None]
         return (all_rois, label, cls_w.astype(jnp.float32),
-                tgt.astype(jnp.float32), inw, outw)
+                tgt.astype(jnp.float32), inw, outw,
+                matched.astype("int32"))
 
     N, R = rois.shape[0], rois.shape[1]
     G = gt.shape[1]
@@ -913,8 +921,11 @@ def generate_proposal_labels(ctx, ins):
     outs = jax.vmap(per_image)(rois.astype(jnp.float32),
                                gt.astype(jnp.float32),
                                gt_cls.astype("int32"), crowd, nroi)
+    # MatchedGt: the labeler's own argmax-IoU gt index (crowd/zero-area gts
+    # masked) -- consumers (generate_mask_targets) reuse it so a fg roi's
+    # mask target can never come from a different gt than its class label
     names = ["Rois", "LabelsInt32", "ClsWeights", "BboxTargets",
-             "BboxInsideWeights", "BboxOutsideWeights"]
+             "BboxInsideWeights", "BboxOutsideWeights", "MatchedGt"]
     return {n: [o] for n, o in zip(names, outs)}
 
 

@@ -604,3 +604,73 @@ def py_func_op(ctx, ins):
         for s, d in zip(shapes, dtypes))
     outs = jax.pure_callback(host, structs, *ins["X"])
     return {"Out": list(outs)}
+
+
+@register("tree_conv", nondiff_inputs=("EdgeSet",))
+def tree_conv(ctx, ins):
+    """Tree-based convolution (TBCNN, reference tree_conv_op.cc +
+    math/tree2col.cc, arXiv:1409.5718).
+
+    NodesVector [B, N, F] (or [N, F]); EdgeSet [B, E, 2] int parent->child
+    pairs, 1-indexed, (0, 0) rows = padding; Filter [F, 3, O, K]. Out
+    [B, N, O, K]. The reference walks each subtree on the CPU building a
+    sparse patch; here the continuous-binary-tree coefficients become three
+    dense [N, N] matrices (eta_t/l/r summed over depths < max_depth, powers
+    of the child adjacency) and the whole op is three matmuls -- MXU-native
+    and O(N^2 F), fine at AST scale.
+    """
+    import jax
+    jnp = _jnp()
+    x, edges, filt = ins["NodesVector"][0], ins["EdgeSet"][0], ins["Filter"][0]
+    max_depth = int(ctx.attr("max_depth", 2))
+    squeeze = x.ndim == 2
+    if squeeze:
+        x, edges = x[None], edges[None]
+    B, N, F = x.shape
+    Fdim, three, O, K = filt.shape
+
+    def one(xb, eb):
+        u = eb[:, 0].astype(jnp.int32)   # parents, 1-indexed; 0 = pad
+        v = eb[:, 1].astype(jnp.int32)
+        valid = (u > 0) & (v > 0)
+        ui = jnp.where(valid, u - 1, N)  # pad rows scatter to a dump slot
+        vi = jnp.where(valid, v - 1, N)
+        # child adjacency [N+1, N+1] with a dump row/col for padding
+        A = jnp.zeros((N + 1, N + 1), x.dtype).at[ui, vi].set(
+            jnp.where(valid, 1.0, 0.0).astype(x.dtype))[:N, :N]
+        # per-child position among its parent's edges (edge order), 1-based
+        E = eb.shape[0]
+        same_parent = (u[:, None] == u[None, :]) & valid[:, None] & \
+            valid[None, :]
+        earlier = jnp.tril(jnp.ones((E, E), x.dtype), k=-1)
+        index1 = (same_parent.astype(x.dtype) * earlier).sum(1) + 1.0
+        pclen_e = same_parent.astype(x.dtype).sum(1)
+        # scatter per-node index/pclen (each node is a child of <=1 parent)
+        idx_n = jnp.zeros((N + 1,), x.dtype).at[vi].set(
+            jnp.where(valid, index1, 0.0).astype(x.dtype))[:N]
+        pcl_n = jnp.ones((N + 1,), x.dtype).at[vi].set(
+            jnp.where(valid, pclen_e, 1.0).astype(x.dtype))[:N]
+        # eta_l/r position term per node (depth-independent)
+        temp = jnp.where(pcl_n <= 1.0, 0.5,
+                         (idx_n - 1.0) / jnp.maximum(pcl_n - 1.0, 1.0))
+        # reach_d[r, v]: v at depth d below r (A^d); trees -> 0/1 entries
+        Ct = jnp.eye(N, dtype=x.dtype)            # d=0: eta_t=1, l=r=0
+        Cl = jnp.zeros((N, N), x.dtype)
+        Cr = jnp.zeros((N, N), x.dtype)
+        reach = jnp.eye(N, dtype=x.dtype)
+        for d in range(1, max_depth):
+            reach = reach @ A
+            eta_t = (max_depth - d) / max_depth
+            eta_l_full = (1.0 - eta_t) * temp          # per node v
+            eta_r_full = (1.0 - eta_t) * (1.0 - eta_l_full)
+            Ct = Ct + reach * eta_t
+            Cl = Cl + reach * eta_l_full[None, :]
+            Cr = Cr + reach * eta_r_full[None, :]
+        pt, pl, pr = Ct @ xb, Cl @ xb, Cr @ xb     # [N, F] each
+        out = (jnp.einsum("nf,fok->nok", pt, filt[:, 0]) +
+               jnp.einsum("nf,fok->nok", pl, filt[:, 1]) +
+               jnp.einsum("nf,fok->nok", pr, filt[:, 2]))
+        return out
+
+    out = jax.vmap(one)(x, edges.astype(jnp.int32))
+    return {"Out": [out[0] if squeeze else out]}

@@ -116,11 +116,25 @@ class HostTable:
                                             name=f"host_table[{name}]")
             self._worker.start()
 
+    def _check_ids(self, ids: np.ndarray, where: str) -> np.ndarray:
+        """Host-side id validation (free of XLA constraints): out-of-range
+        ids raise instead of silently reading/training row vocab_size-1 --
+        that clamp corrupted data untraceably in a beyond-HBM table."""
+        ids = np.asarray(ids, np.int64)
+        bad = (ids < 0) | (ids >= self.vocab_size)
+        if bad.any():
+            examples = np.unique(ids[bad])[:8].tolist()
+            raise IndexError(
+                f"host table {self.name!r}: {int(bad.sum())} id(s) out of "
+                f"range [0, {self.vocab_size}) in {where}, e.g. {examples} "
+                f"-- check the feed's hashing/vocab")
+        return ids
+
     # ---- pull ------------------------------------------------------------
     def gather(self, ids: np.ndarray) -> np.ndarray:
         """Lock-free read (Hogwild-style: concurrent async pushes may be
         partially visible; exact under sync mode)."""
-        idx = np.clip(np.asarray(ids, np.int64), 0, self.vocab_size - 1)
+        idx = self._check_ids(ids, "gather")
         return self.table[idx.reshape(-1)].reshape(idx.shape + (self.dim,))
 
     # ---- push ------------------------------------------------------------
@@ -183,8 +197,7 @@ class HostTable:
         self._closed = True
 
     def _apply(self, ids, grads):
-        ids = np.clip(np.asarray(ids, np.int64).reshape(-1), 0,
-                      self.vocab_size - 1)
+        ids = self._check_ids(np.asarray(ids).reshape(-1), "push")
         g = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
         # Duplicate ids in one minibatch sum their contributions first (the
         # SelectedRows merge-add semantic) so the update matches the dense

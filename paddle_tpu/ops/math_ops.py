@@ -121,6 +121,29 @@ def cross_entropy(ctx, ins):
     return {"Y": [loss]}
 
 
+@register("cross_entropy2", nondiff_inputs=("Label",))
+def cross_entropy2(ctx, ins):
+    """Reference cross_entropy2_op.cc: hard-label CE over probabilities,
+    additionally emitting the matched probability MatchX (its grad kernel's
+    saved value; XShape is the reference's reshape bookkeeping, not needed
+    here)."""
+    import jax
+    jnp = _jnp()
+    x, label = ins["X"][0], ins["Label"][0]
+    lab = label
+    if lab.ndim == x.ndim and lab.shape[-1] == 1:
+        lab = jnp.squeeze(lab, axis=-1)
+    ignore = ctx.attr("ignore_index", -100)
+    keep = lab[..., None] != ignore
+    # clamp BEFORE the gather so an ignored negative label (-1, this
+    # codebase's own ignore convention in target assignment) cannot alias
+    # class 0; the reference kernel masks unconditionally too
+    safe = jnp.where(keep, lab[..., None], 0).astype("int32")
+    picked = jnp.take_along_axis(x, safe, axis=-1)
+    loss = jnp.where(keep, -jnp.log(picked), jnp.zeros_like(picked))
+    return {"Y": [loss], "MatchX": [jax.lax.stop_gradient(picked)]}
+
+
 @register("sigmoid_cross_entropy_with_logits")
 def sigmoid_ce(ctx, ins):
     jnp = _jnp()

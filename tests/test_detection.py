@@ -416,3 +416,71 @@ def test_multi_box_head_shapes():
     assert confs.shape == (2, M, 5)
     assert variances.shape == (M, 4)
     assert (boxes[:, 2] > boxes[:, 0]).all()
+
+
+def test_tree_conv_vs_reference_walk():
+    """tree_conv dense-coefficient lowering vs a numpy replica of the
+    reference's DFS patch construction (math/tree2col.cc eta formulas)."""
+    rng = np.random.RandomState(3)
+    N, F, O, K, D = 6, 4, 3, 2, 2
+    x_np = rng.randn(N, F).astype("float32")
+    filt_np = rng.randn(F, 3, O, K).astype("float32")
+    # tree (1-indexed): 1 -> {2, 3}, 2 -> {4, 5}, 3 -> {6}
+    edges_np = np.array([[1, 2], [1, 3], [2, 4], [2, 5], [3, 6], [0, 0]],
+                        "int32")
+
+    def brute():
+        children = {}
+        for u, v in edges_np:
+            if u > 0:
+                children.setdefault(int(u), []).append(int(v))
+        out = np.zeros((N, O, K), "float32")
+        for root in range(1, N + 1):
+            # patch: (node, index1, pclen, depth), DFS bounded by D
+            patch = [(root, 1, 1, 0)]
+            stack = [(root, 0)]
+            seen = {root}
+            while stack:
+                node, depth = stack.pop()
+                if depth + 1 >= D:
+                    continue
+                kids = children.get(node, [])
+                for i, v in enumerate(kids):
+                    if v in seen:
+                        continue
+                    seen.add(v)
+                    patch.append((v, i + 1, len(kids), depth + 1))
+                    stack.append((v, depth + 1))
+            pt = np.zeros(F); pl = np.zeros(F); pr = np.zeros(F)
+            for node, idx, pclen, depth in patch:
+                eta_t = (D - depth) / D
+                tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1 - eta_t) * tmp
+                eta_r = (1 - eta_t) * (1 - eta_l)
+                feat = x_np[node - 1]
+                pt += eta_t * feat; pl += eta_l * feat; pr += eta_r * feat
+            out[root - 1] = (np.einsum("f,fok->ok", pt, filt_np[:, 0]) +
+                             np.einsum("f,fok->ok", pl, filt_np[:, 1]) +
+                             np.einsum("f,fok->ok", pr, filt_np[:, 2]))
+        return out
+
+    def build():
+        A = dict(append_batch_size=False)
+        nv = fluid.data("nv", [1, N, F], "float32", **A)
+        es = fluid.data("es", [1, edges_np.shape[0], 2], "int32", **A)
+        out = layers.tree_conv(nv, es, output_size=O, num_filters=K,
+                               max_depth=D, act=None, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="tconv_w"))
+        return [out]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope().set_var("tconv_w", filt_np)
+        got, = exe.run(main, feed={"nv": x_np[None], "es": edges_np[None]},
+                       fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got)[0], brute(), rtol=1e-4,
+                               atol=1e-5)

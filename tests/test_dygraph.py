@@ -272,3 +272,47 @@ def test_traced_layer_keeps_autograd_alive():
         loss.backward()
         assert lin.weight.gradient() is not None
         assert np.abs(lin.weight.gradient()).sum() > 0
+
+
+def test_dygraph_tail_classes():
+    """NCE / SequenceConv / SpectralNorm / TreeConv (reference dygraph/nn.py
+    class tail; VERDICT r3 #9)."""
+    import jax.numpy as jnp
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph import nn as dnn
+
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        # NCE: cost finite + weight grads flow
+        nce = dnn.NCE(num_total_classes=20, dim=8, num_neg_samples=5)
+        x = dygraph.to_variable(rng.randn(4, 8).astype("float32"))
+        lab = dygraph.to_variable(rng.randint(0, 20, (4, 1)).astype("int64"))
+        cost = nce(x, lab)
+        assert cost.shape == (4, 1)
+        total = cost.numpy().sum()
+        assert np.isfinite(total)
+
+        # SequenceConv over padded [B, T, D]
+        sc = dnn.SequenceConv(num_filters=6, filter_size=3, input_dim=5)
+        seq = dygraph.to_variable(rng.randn(2, 7, 5).astype("float32"))
+        out = sc(seq)
+        assert out.shape == (2, 7, 6)
+
+        # SpectralNorm: normalized weight has sigma_max ~= 1 after a few
+        # power iterations; U/V state persists between calls
+        sn = dnn.SpectralNorm([6, 4], power_iters=8)
+        w = dygraph.to_variable((rng.randn(6, 4) * 3).astype("float32"))
+        u_before = sn.weight_u.numpy().copy()
+        wn = sn(w)
+        assert not np.allclose(sn.weight_u.numpy(), u_before)
+        sigma = np.linalg.svd(np.asarray(wn.numpy()), compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, rtol=0.05)
+
+        # TreeConv on a tiny tree
+        tc = dnn.TreeConv(feature_size=5, output_size=3, num_filters=2)
+        nodes = dygraph.to_variable(rng.randn(1, 4, 5).astype("float32"))
+        edges = dygraph.to_variable(
+            np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]], "int32"))
+        out = tc(nodes, edges)
+        assert out.shape == (1, 4, 3, 2)
+        assert np.isfinite(out.numpy()).all()

@@ -48,7 +48,7 @@ def test_generate_proposal_labels_semantics():
 
     feeds = {"rois": rois_np, "gt": gt_np, "cls": cls_np, "num": num_np,
              "im": np.array([[80, 80, 1.0]], np.float32)}
-    s_rois, labels, tgt, inw, outw, clsw = _run(build, feeds)
+    s_rois, labels, tgt, inw, outw, clsw, matched = _run(build, feeds)
     # R' = 5 proposals + 2 appended gts
     assert s_rois.shape == (1, 7, 4) and labels.shape == (1, 7)
     # appended gts are perfect matches -> fg with their own class

@@ -159,3 +159,17 @@ def test_shape_mismatch_rejected():
     with pytest.raises(ValueError, match="already exists"):
         ht.create_table(name, 10, 8)
     ht.drop_table(name)
+
+
+def test_out_of_range_ids_raise():
+    """Out-of-range ids must raise (host-side check), not silently clamp to
+    the last row (advisor r3: clamp corruption is untraceable in a
+    beyond-HBM table)."""
+    from paddle_tpu.ops.host_table import HostTable
+    t = HostTable("oor", vocab_size=8, dim=2)
+    with pytest.raises(IndexError, match="out of range"):
+        t.gather(np.array([3, 8]))
+    with pytest.raises(IndexError, match="out of range"):
+        t.push(np.array([-1]), np.ones((1, 2), np.float32))
+    # in-range still works
+    assert t.gather(np.array([0, 7])).shape == (2, 2)

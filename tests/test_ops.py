@@ -834,3 +834,24 @@ def test_collective_prod_is_product():
     out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
     np.testing.assert_allclose(np.asarray(out),
                                np.full(8, np.prod(x), "float32"))
+
+
+def test_cross_entropy2_matches_cross_entropy():
+    """cross_entropy2 (reference nn.py:1917): same loss as hard-label
+    cross_entropy, plus the saved MatchX."""
+    import paddle_tpu as fluid
+    rng = np.random.RandomState(0)
+    probs = rng.dirichlet(np.ones(5), size=6).astype("float32")
+    label = rng.randint(0, 5, (6, 1)).astype("int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        x = fluid.data("x", [6, 5], "float32", **A)
+        y = fluid.data("y", [6, 1], "int64", **A)
+        l2 = fluid.layers.cross_entropy2(x, y)
+        l1 = fluid.layers.cross_entropy(x, y)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        a, b = exe.run(main, feed={"x": probs, "y": label},
+                       fetch_list=[l2, l1])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""API-surface signature printer (reference tools/print_signatures.py +
+diff_api.py). Emits one sorted line per public callable:
+
+    <module path>.<name> <inspect signature>
+
+Used by tests/test_api_spec.py to freeze the surface: regenerate with
+
+    python tools/print_signatures.py > tests/api_spec.txt
+
+and review the diff -- silent removals AND silent additions both fail CI.
+"""
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+MODULES = [
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.io",
+    "paddle_tpu.initializer",
+    "paddle_tpu.clip",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.metrics",
+    "paddle_tpu.nets",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.layers.distributions",
+    "paddle_tpu.contrib.slim",
+    "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.contrib.quantize",
+]
+
+
+def _signature(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def iter_api():
+    import importlib
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.ismodule(obj):
+                continue
+            # only symbols that belong to the package (not re-exported numpy etc.)
+            owner = getattr(obj, "__module__", "") or ""
+            if not owner.startswith("paddle_tpu"):
+                continue
+            if inspect.isclass(obj):
+                yield f"{modname}.{name} class{_signature(obj)}"
+                for mname, m in sorted(vars(obj).items()):
+                    if mname.startswith("_") or not callable(m):
+                        continue
+                    yield (f"{modname}.{name}.{mname} "
+                           f"method{_signature(m)}")
+            elif callable(obj):
+                yield f"{modname}.{name} def{_signature(obj)}"
+
+
+def main():
+    for line in sorted(set(iter_api())):
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

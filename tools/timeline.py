@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Chrome-trace timeline tool (reference tools/timeline.py:36).
+
+Convert a profiler capture into chrome://tracing / Perfetto JSON:
+
+    python tools/timeline.py --trace_dir /tmp/my_trace --timeline_path out.json
+
+Merge multiple per-process captures (the reference's
+'--profile_path a,b,c' multi-process merge):
+
+    python tools/timeline.py --profile_path rank0.json.gz,rank1.json.gz \
+        --timeline_path merged.json
+
+Open the output at chrome://tracing or https://ui.perfetto.dev.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu import profiler
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace_dir", default=None,
+                    help="directory passed to fluid.profiler.profiler("
+                         "trace_dir=...)")
+    ap.add_argument("--profile_path", default=None,
+                    help="comma-separated chrome trace files (.json/.json.gz)"
+                         " to merge with disjoint pids")
+    ap.add_argument("--timeline_path", default="timeline.json")
+    args = ap.parse_args()
+
+    if args.profile_path:
+        out = profiler.merge_chrome_traces(
+            [p for p in args.profile_path.split(",") if p],
+            args.timeline_path)
+    elif args.trace_dir:
+        out = profiler.export_chrome_tracing(args.trace_dir,
+                                             args.timeline_path)
+    else:
+        ap.error("pass --trace_dir or --profile_path")
+        return
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
