@@ -79,6 +79,12 @@ class BuildStrategy:
     def __init__(self):
         object.__setattr__(self, "_init_done", False)
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        # Reduce mode shards optimizer state over dp. reduce_params=True
+        # additionally shards the Parameters themselves (the reference
+        # ReduceOpHandle's per-device ownership + broadcast-on-use, ZeRO-3
+        # style: GSPMD inserts the all-gather at each use). Opt-in: the
+        # all-gather trades step latency for per-chip parameter memory.
+        self.reduce_params = False
         self.gradient_scale_strategy = \
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
         self.debug_graphviz_path = ""
@@ -209,7 +215,8 @@ class CompiledProgram:
         return (tuple(sorted(ds.mesh_shape.items())),
                 tuple((p, tuple(s)) for p, s in ds.param_rules),
                 tuple((p, tuple(s)) for p, s in ds.data_rules),
-                ds.data_axis, self.build_strategy.reduce_strategy)
+                ds.data_axis, self.build_strategy.reduce_strategy,
+                getattr(self.build_strategy, "reduce_params", False))
 
     @property
     def mesh(self):
@@ -238,14 +245,32 @@ class CompiledProgram:
         bs = self.build_strategy
         reduce_mode = (bs.reduce_strategy == BuildStrategy.ReduceStrategy.Reduce
                        and "dp" in mesh.shape and mesh.shape["dp"] > 1)
-        if (reduce_mode and v is not None and spec == P()
-                and not isinstance(v, Parameter)):
-            # ZeRO-style accumulator sharding (details/reduce_op_handle.* analog)
+        shardable = (v is not None and spec == P() and
+                     (not isinstance(v, Parameter) or
+                      getattr(bs, "reduce_params", False)))
+        if reduce_mode and shardable:
+            # ZeRO-style sharding over dp (details/reduce_op_handle.* analog):
+            # optimizer accumulators always; Parameters too when
+            # reduce_params is set (GSPMD all-gathers them at each use)
             ndp = mesh.shape["dp"]
             for dim, s in enumerate(v.shape):
                 if isinstance(s, int) and s > 0 and s % ndp == 0:
                     spec = P(*([None] * dim), "dp")
                     break
+            else:
+                if (any(isinstance(s, int) and s > ndp for s in v.shape)
+                        and name not in _warned_knobs):
+                    # big but unevenly-shaped: replication costs real memory,
+                    # tell the user instead of silently diverging from the
+                    # expected 1/dp footprint (once per var; NOT the no-op
+                    # knob wording -- the strategy IS active elsewhere)
+                    _warned_knobs.add(name)
+                    warnings.warn(
+                        f"paddle_tpu: ReduceStrategy.Reduce keeps {name!r} "
+                        f"replicated: no dim of shape {tuple(v.shape)} "
+                        f"divides dp={ndp} (pad the dim or change dp for "
+                        f"the full ZeRO memory win; other state still "
+                        f"shards)")
         return NamedSharding(mesh, spec)
 
     # Program-API passthroughs used by Executor

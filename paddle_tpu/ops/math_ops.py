@@ -134,11 +134,13 @@ def cross_entropy2(ctx, ins):
     if lab.ndim == x.ndim and lab.shape[-1] == 1:
         lab = jnp.squeeze(lab, axis=-1)
     ignore = ctx.attr("ignore_index", -100)
-    keep = lab[..., None] != ignore
-    # clamp BEFORE the gather so an ignored negative label (-1, this
-    # codebase's own ignore convention in target assignment) cannot alias
-    # class 0; the reference kernel masks unconditionally too
-    safe = jnp.where(keep, lab[..., None], 0).astype("int32")
+    li = lab[..., None]
+    # rows are kept only when the label is both not-ignored AND in range:
+    # out-of-range labels (e.g. a -1 ignore convention while ignore_index
+    # stays at the -100 default) would otherwise be clipped by the gather to
+    # the last class and silently train toward it
+    keep = (li != ignore) & (li >= 0) & (li < x.shape[-1])
+    safe = jnp.where(keep, li, 0).astype("int32")
     picked = jnp.take_along_axis(x, safe, axis=-1)
     loss = jnp.where(keep, -jnp.log(picked), jnp.zeros_like(picked))
     return {"Y": [loss], "MatchX": [jax.lax.stop_gradient(picked)]}

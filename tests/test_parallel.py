@@ -190,3 +190,125 @@ def test_reduce_strategy_zero_shards_optimizer_state():
     red_shard = int(np.prod(m_red.addressable_shards[0].data.shape))
     assert ar_shard == full
     assert red_shard == full // len(jax.devices())
+
+
+def test_reduce_strategy_uneven_dims_and_total_memory():
+    """ZeRO hardening (VERDICT r3 #8): total optimizer-state bytes shard to
+    ~1/dp; an accumulator with no dp-divisible dim falls back to replication
+    (with a warning) and stays numerically correct."""
+    import jax
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        startup.random_seed = 3
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [24], "float32")
+            label = fluid.data("label", [1], "int64")
+            h = fluid.layers.fc(x, 64, act="relu")
+            # 13 is coprime with dp=8: its accumulators cannot shard evenly
+            odd = fluid.layers.fc(h, 13, act="relu")
+            logits = fluid.layers.fc(odd, 8)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        return main, startup, loss
+
+    def train(cp, startup, loss):
+        rng = np.random.RandomState(5)
+        exe = fluid.Executor()
+        out, moments = [], {}
+        with fluid.scope_guard(fluid.Scope()):
+            sc = fluid.global_scope()
+            exe.run(startup)
+            for _ in range(3):
+                x = rng.randn(16, 24).astype("float32")
+                y = rng.randint(0, 8, (16, 1)).astype("int64")
+                lv, = exe.run(cp, feed={"x": x, "label": y},
+                              fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(())))
+            for n in sc.var_names():
+                if "moment" in n:
+                    moments[n] = sc.find_var(n)
+        return out, moments
+
+    main, startup, loss = build()
+    cp_ar = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    ref, _ = train(cp_ar, startup, loss)
+
+    main2, startup2, loss2 = build()
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    cp = fluid.CompiledProgram(main2, build_strategy=bs)\
+        .with_data_parallel(loss_name=loss2.name)
+    got, moments = train(cp, startup2, loss2)
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+
+    ndev = len(jax.devices())
+    full = shard = 0
+    for n, m in moments.items():
+        full += int(np.prod(m.shape))
+        shard += int(np.prod(m.addressable_shards[0].data.shape))
+    # the [13]-shaped bias accumulators (13 coprime with dp=8) replicate;
+    # everything else shards 1/dp -> a real aggregate memory win
+    assert shard < full * 0.45, (shard, full)
+    uneven = next(m for n, m in moments.items() if tuple(m.shape) == (13,))
+    assert int(np.prod(uneven.addressable_shards[0].data.shape)) == 13
+
+
+def test_reduce_params_shards_parameters_with_allgather_on_use():
+    """BuildStrategy.reduce_params: Parameters themselves shard over dp
+    (the reference ReduceOpHandle ownership semantics, ZeRO-3 style) with
+    GSPMD all-gather on use; loss parity vs plain dp."""
+    import jax
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 7
+        startup.random_seed = 7
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [32], "float32")
+            label = fluid.data("label", [1], "int64")
+            h = fluid.layers.fc(x, 64, act="relu")
+            logits = fluid.layers.fc(h, 8)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        return main, startup, loss
+
+    def train(cp, startup, loss):
+        rng = np.random.RandomState(9)
+        exe = fluid.Executor()
+        out = []
+        with fluid.scope_guard(fluid.Scope()):
+            sc = fluid.global_scope()
+            exe.run(startup)
+            for _ in range(4):
+                x = rng.randn(16, 32).astype("float32")
+                y = rng.randint(0, 8, (16, 1)).astype("int64")
+                lv, = exe.run(cp, feed={"x": x, "label": y},
+                              fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(())))
+            w = sc.find_var("fc_0.w_0")
+        return out, w
+
+    main, startup, loss = build()
+    cp_ar = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    ref, w_ar = train(cp_ar, startup, loss)
+
+    main2, startup2, loss2 = build()
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    bs.reduce_params = True
+    cp = fluid.CompiledProgram(main2, build_strategy=bs)\
+        .with_data_parallel(loss_name=loss2.name)
+    got, w_red = train(cp, startup2, loss2)
+
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+    ndev = len(jax.devices())
+    assert int(np.prod(w_ar.addressable_shards[0].data.shape)) == \
+        int(np.prod(w_ar.shape))
+    assert int(np.prod(w_red.addressable_shards[0].data.shape)) == \
+        int(np.prod(w_red.shape)) // ndev
