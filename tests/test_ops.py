@@ -855,3 +855,27 @@ def test_cross_entropy2_matches_cross_entropy():
         a, b = exe.run(main, feed={"x": probs, "y": label},
                        fetch_list=[l2, l1])
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_gelu_approximate_attr():
+    """gelu approximate=True must compute the tanh form (the BERT/bench
+    fast path), approximate=False the erf form."""
+    import paddle_tpu as fluid
+    x_np = np.linspace(-3, 3, 31).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [31], "float32", append_batch_size=False)
+        tanh_form = fluid.layers.gelu(x, approximate=True)
+        erf_form = fluid.layers.gelu(x, approximate=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        a, b = exe.run(main, feed={"x": x_np}, fetch_list=[tanh_form,
+                                                           erf_form])
+    want_tanh = 0.5 * x_np * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (x_np + 0.044715 * x_np ** 3)))
+    want_erf = 0.5 * x_np * (1 + special.erf(x_np / np.sqrt(2)))
+    np.testing.assert_allclose(np.asarray(a), want_tanh, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(b), want_erf, rtol=1e-5,
+                               atol=1e-6)
+    assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-6  # distinct
