@@ -67,10 +67,13 @@ def bench_transformer(batch=64, seq=64):
             exe.run(main, feed=feed, fetch_list=[], return_numpy=False)
         scope = fluid.global_scope()
         _sync(scope.find_var("src_emb"))
+        # these steps are 10-30 ms: longer segments keep the relay's fixed
+        # sync overhead small relative to the differential (r4: run-to-run
+        # variance at the default lengths was ~15%)
         per_step = _timed_steps(
             lambda: exe.run(main, feed=feed, fetch_list=[],
                             return_numpy=False),
-            lambda: scope.find_var("src_emb"))
+            lambda: scope.find_var("src_emb"), n_short=10, n_long=120)
     # source + target tokens processed per step
     return 2 * batch * seq / per_step, per_step
 
@@ -108,7 +111,7 @@ def bench_deepfm(batch=4096, fields=26, vocab=1_000_000, embed=16):
         per_step = _timed_steps(
             lambda: exe.run(main, feed=feed, fetch_list=[],
                             return_numpy=False),
-            lambda: scope.find_var("fm_v"))
+            lambda: scope.find_var("fm_v"), n_short=10, n_long=120)
     return batch / per_step, per_step
 
 
