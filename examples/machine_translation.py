@@ -34,24 +34,38 @@ def main():
             label_smooth_eps=0.0)
         fluid.optimizer.Adam(2e-3).minimize(loss)
 
-    rng = np.random.RandomState(0)
     pos = np.tile(np.arange(S, dtype="int64"), (B, 1))
-    ones = np.ones((B, S), "float32")
+
+    # dataset.wmt16 reader (cached corpus if present, else its synthetic
+    # permuted-reversal parallel corpus -- same chapter flow either way)
+    from paddle_tpu.dataset import wmt16
+    pairs = []
+    for s_ids, trg_in, trg_lbl in wmt16.train(120, 120)():
+        def pad(xs):
+            xs = list(xs)[:S]
+            return xs + [1] * (S - len(xs)), min(len(xs), S)
+        sp, sl = pad(s_ids)
+        tp, _ = pad(trg_in)
+        lp, ll = pad(trg_lbl)
+        mask_s = [1.0] * sl + [0.0] * (S - sl)
+        mask_t = [1.0] * ll + [0.0] * (S - ll)
+        pairs.append((sp, mask_s, tp, mask_t, lp))
+    rng = np.random.RandomState(0)
 
     def make_batch():
-        # task: target = source reversed, +1 mod vocab
-        s = rng.randint(2, 118, (B, S)).astype("int64")
-        t = ((s[:, ::-1] + 1) % 120).astype("int64")
-        trg_in = np.concatenate([np.ones((B, 1), "int64"),
-                                 t[:, :-1]], 1)
-        return {"src": s, "spos": pos, "smask": ones, "trg": trg_in,
-                "tpos": pos, "tmask": ones, "lbl": t}
+        sel = rng.randint(0, len(pairs), B)
+        cols = list(zip(*(pairs[i] for i in sel)))
+        return {"src": np.array(cols[0], "int64"), "spos": pos,
+                "smask": np.array(cols[1], "float32"),
+                "trg": np.array(cols[2], "int64"), "tpos": pos,
+                "tmask": np.array(cols[3], "float32"),
+                "lbl": np.array(cols[4], "int64")}
 
     exe = fluid.Executor()
     exe.run(startup)
-    for step in range(300):
+    for step in range(800):
         lv, = exe.run(main_p, feed=make_batch(), fetch_list=[loss])
-        if step % 100 == 0:
+        if step % 200 == 0:
             print(f"step {step}: loss "
                   f"{float(np.asarray(lv).reshape(())):.3f}")
     print("final loss:", float(np.asarray(lv).reshape(())))

@@ -1,0 +1,129 @@
+"""Personalized recommendation on MovieLens (reference
+tests/book/test_recommender_system.py): user and movie feature towers
+(embeddings + fc, title sequence_conv pooled) fused by cos_sim, trained to
+the scaled rating with square_error_cost. Exercises cos_sim end-to-end at
+model scale."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dataset import movielens
+
+EMB = 16
+TITLE_LEN = 8
+MAX_CATS = 4
+
+
+def load(split, limit):
+    reader = (movielens.train if split == "train" else movielens.test)()
+    rows = {k: [] for k in ("uid", "gender", "age", "job", "mid", "cat",
+                            "title", "title_len", "rating")}
+    pad_cat = movielens.movie_categories()   # reserved id: vocab is n+1
+    for (uid, gender, age, job, mid, cats, title, rating) in (
+            tuple(r) for r in reader()):
+        rows["uid"].append(uid)
+        rows["gender"].append(gender)
+        rows["age"].append(age)
+        rows["job"].append(job)
+        rows["mid"].append(mid)
+        c = (list(cats) + [pad_cat] * MAX_CATS)[:MAX_CATS]
+        rows["cat"].append(c)
+        t = (list(title) + [0] * TITLE_LEN)[:TITLE_LEN]
+        rows["title"].append(t)
+        rows["title_len"].append(min(len(title), TITLE_LEN))
+        rows["rating"].append(rating[0])
+        if len(rows["uid"]) >= limit:
+            break
+    out = {k: np.array(v, "int64") for k, v in rows.items()
+           if k not in ("rating",)}
+    out["rating"] = np.array(rows["rating"], "float32")[:, None]
+    return out
+
+
+def build(n_users, n_movies, n_jobs, n_cats, n_title):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        A = dict(append_batch_size=False)
+        uid = fluid.data("uid", [-1, 1], "int64", **A)
+        gender = fluid.data("gender", [-1, 1], "int64", **A)
+        age = fluid.data("age", [-1, 1], "int64", **A)
+        job = fluid.data("job", [-1, 1], "int64", **A)
+        mid = fluid.data("mid", [-1, 1], "int64", **A)
+        cat = fluid.data("cat", [-1, MAX_CATS], "int64", **A)
+        title = fluid.data("title", [-1, TITLE_LEN], "int64", **A)
+        tlen = fluid.data("title_len", [-1], "int64", **A)
+        rating = fluid.data("rating", [-1, 1], "float32", **A)
+
+        def tower_feature(ids, vocab, width=EMB):
+            e = fluid.layers.embedding(ids, [vocab, width])
+            return fluid.layers.fc(
+                fluid.layers.reshape(e, [-1, width]), width)
+
+        usr = fluid.layers.concat(
+            [tower_feature(uid, n_users + 1, 32),
+             tower_feature(gender, 2), tower_feature(age, 8),
+             tower_feature(job, n_jobs + 1)], axis=1)
+        usr = fluid.layers.fc(usr, 200, act="tanh")
+
+        mov_id_f = tower_feature(mid, n_movies + 1, 32)
+        cat_emb = fluid.layers.embedding(cat, [n_cats + 1, 32])
+        cat_f = fluid.layers.reduce_sum(cat_emb, dim=1)
+        title_emb = fluid.layers.embedding(title, [n_title + 1, 32])
+        title_conv = fluid.layers.sequence_conv(title_emb, 32, filter_size=3,
+                                                length=tlen)
+        title_f = fluid.layers.sequence_pool(title_conv, "sum", length=tlen)
+        mov = fluid.layers.concat([mov_id_f, cat_f, title_f], axis=1)
+        mov = fluid.layers.fc(mov, 200, act="tanh")
+
+        sim = fluid.layers.cos_sim(usr, mov)             # [-1, 1]
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, rating))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    train_rows = load("train", 24000)
+    test_rows = load("test", 512)
+    n_title = len(movielens.get_movie_title_dict())
+    main_prog, startup, loss = build(movielens.max_user_id(),
+                                     movielens.max_movie_id(),
+                                     movielens.max_job_id(),
+                                     movielens.movie_categories(),
+                                     n_title)
+    exe = fluid.Executor()
+    bs = 256
+    n = len(train_rows["uid"])
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for ep in range(12):
+            losses = []
+            for i in range(0, n - bs + 1, bs):
+                feed = {k: v[i:i + bs] for k, v in train_rows.items()}
+                lv, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(())))
+            if ep % 4 == 0 or ep == 11:
+                print(f"epoch {ep}: train mse={np.mean(losses):.4f}")
+        tn = len(test_rows["uid"])
+        tl = []
+        for i in range(0, tn - bs + 1, bs):
+            feed = {k: v[i:i + bs] for k, v in test_rows.items()}
+            lv, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                          use_prune=True)
+            tl.append(float(np.asarray(lv).reshape(())))
+        test_mse = float(np.mean(tl)) if tl else float(np.mean(losses))
+    # the meaningful bar: beat always-predict-the-mean on held-out pairs
+    var = float(np.var(test_rows["rating"]))
+    print(f"test mse: {test_mse:.4f} (predict-mean baseline {var:.4f})")
+    assert test_mse < 0.7 * var, (test_mse, var)
+
+
+if __name__ == "__main__":
+    main()

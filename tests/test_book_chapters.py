@@ -153,3 +153,53 @@ def test_label_semantic_roles_crf_learns():
         correct += (pv[b, :n] == labels[b, :n]).sum()
         total += n
     assert correct / total > 0.8, correct / total
+
+
+def test_movielens_loader_and_helpers(tmp_path, monkeypatch):
+    # force the synthetic path: these invariants are the surrogate's (a
+    # machine with real cached data would legitimately differ)
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import movielens
+    movielens._CACHE = None
+    rows = list(movielens.train()())
+    assert len(rows) > 1000
+    uid, gender, age, job, mid, cats, title, rating = rows[0]
+    assert 1 <= uid <= movielens.max_user_id()
+    assert 1 <= mid <= movielens.max_movie_id()
+    assert gender in (0, 1) and 0 <= age < 8
+    assert 0 <= job <= movielens.max_job_id()
+    assert all(0 <= c < movielens.movie_categories() for c in cats)
+    assert isinstance(rating, list) and len(rating) == 1
+    assert len(movielens.get_movie_title_dict()) > 10
+    # split is deterministic and partitions the ratings exactly
+    test_rows = list(movielens.test()())
+    assert len(test_rows) > 0
+    assert list(movielens.train()()) == rows          # re-read identical
+    total = len(movielens._corpus()[2])
+    assert len(rows) + len(test_rows) == total
+
+
+def test_wmt16_loader_conventions(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import wmt16
+    d = wmt16.get_dict("en", 50)
+    assert d["<s>"] == 0 and d["<e>"] == 1 and d["<unk>"] == 2
+    rd = wmt16.get_dict("en", 50, reverse=True)
+    assert rd[0] == "<s>"
+    pairs = list(wmt16.train(50, 50)())
+    src, trg_in, trg_lbl = pairs[0]
+    assert trg_in[0] == 0            # <s>-prefixed decoder input
+    assert trg_lbl[-1] == 1          # <e>-suffixed label
+    assert trg_in[1:] == trg_lbl[:-1]
+    assert all(w >= 3 for w in src)
+
+
+def test_flowers_loader_shapes(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import flowers
+    it = flowers.train()()
+    img, label = next(it)
+    assert img.shape == (3, 32, 32) and img.dtype == np.float32
+    assert 0 <= label < 102
+    labels = {l for _, l in flowers.test()()}
+    assert len(labels) == 102
