@@ -1009,7 +1009,7 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
 
 def host_embedding(input, size, name, optimizer="adagrad", learning_rate=0.05,
                    dtype="float32", initializer=None, mmap_dir=None,
-                   async_updates=False, seed=0):
+                   async_updates=False, seed=0, row_shard_axis=None):
     """Embedding lookup against a host-RAM (or memmapped) table -- the
     beyond-HBM sparse path (reference: distributed lookup table,
     transpiler/distribute_transpiler.py:1594, distributed_lookup_table_op).
@@ -1022,13 +1022,30 @@ def host_embedding(input, size, name, optimizer="adagrad", learning_rate=0.05,
 
     ``name`` is required and process-global: it keys the table for
     checkpointing (host_table.save_all) and re-use across programs.
+
+    ``row_shard_axis``: name of a mesh axis to row-partition the table over
+    (the cross-process pserver sharding, reference
+    distribute_transpiler.py:990 param blocks). Under multi-process, each
+    process stores ONLY its contiguous row range -- the table can exceed
+    one host's RAM+disk -- and lookups/pushes run per-process callbacks
+    against the local shard, reassembled by a psum over the axis (see
+    ops/host_table.py). The strategy's mesh must carry that axis with size
+    == process count, ordered so each process's devices sit at its own
+    axis index (parallel/env.global_mesh does this). Single-process, the
+    full table is kept and the axis partitions work, not memory.
     """
     from ..ops import host_table as ht
     from ..initializer import Constant
 
+    row_shard = None
+    if row_shard_axis is not None:
+        import jax
+        if jax.process_count() > 1:
+            row_shard = (jax.process_index(), jax.process_count())
     ht.create_table(name, size[0], size[1], optimizer=optimizer,
                     lr=learning_rate, initializer=initializer,
-                    mmap_dir=mmap_dir, async_updates=async_updates, seed=seed)
+                    mmap_dir=mmap_dir, async_updates=async_updates, seed=seed,
+                    row_shard=row_shard)
     helper = LayerHelper("host_embedding", name=name + ".anchor")
     from ..layer_helper import ParamAttr
     anchor = helper.create_parameter(
@@ -1038,7 +1055,8 @@ def host_embedding(input, size, name, optimizer="adagrad", learning_rate=0.05,
     helper.append_op("host_lookup_table",
                      inputs={"Ids": [input], "Anchor": [anchor]},
                      outputs={"Out": [out]},
-                     attrs={"table_name": name, "dtype": dtype})
+                     attrs={"table_name": name, "dtype": dtype,
+                            "shard_axis": row_shard_axis})
     return _var(helper, out)
 
 

@@ -31,14 +31,23 @@ with at least one differentiable input), every table gets a device-side
 io_callback returns the anchor's (zero) gradient so the callback is
 data-depended-on and never DCE'd by XLA.
 
-Multi-host: works as the classic single-pserver topology with no extra
-code — under multi-host GSPMD, jax gathers callback operands to process 0,
-runs the callback there alone, and broadcasts the result, so process 0's
-host RAM/memmap is the parameter server (tested: 2-process loss parity and
-pserver-rank push accounting in tests/test_multihost.py). Checkpoint host
-tables from process 0 (the only rank whose table advances). On-chip tables
-that fit HBM should use EP sharding (``models/deepfm.py:ep_param_rules``)
-instead.
+Multi-host, two topologies:
+  * default — the classic single-pserver with no extra code: under
+    multi-host GSPMD, jax gathers callback operands to process 0, runs the
+    callback there alone, and broadcasts the result, so process 0's host
+    RAM/memmap is the parameter server (2-process loss parity and
+    pserver-rank push accounting in tests/test_multihost.py). Checkpoint
+    from process 0 (the only rank whose table advances).
+  * ``row_shard_axis`` — ROWS partitioned across processes (the reference
+    pserver param blocks, distribute_transpiler.py:990): each process
+    stores only rows [lo, hi) so capacity scales with hosts; lookups/pushes
+    run through a shard_map island over the axis (one callback per device,
+    per PROCESS under multi-host, against the local shard; non-shard mesh
+    axes are replica-gated to zero grads so each row updates once) and a
+    psum reassembles the minibatch rows. Checkpoint every rank (save/load
+    write per-shard files).
+On-chip tables that fit HBM should use EP sharding
+(``models/deepfm.py:ep_param_rules``) instead.
 """
 from __future__ import annotations
 
@@ -66,10 +75,18 @@ class HostTable:
     bf16 grads are upcast on arrival).
     """
 
+    @staticmethod
+    def shard_bounds(vocab_size: int, n_shards: int, shard: int):
+        """Contiguous row range [lo, hi) owned by ``shard`` of n_shards."""
+        lo = (vocab_size * shard) // n_shards
+        hi = (vocab_size * (shard + 1)) // n_shards
+        return lo, hi
+
     def __init__(self, name: str, vocab_size: int, dim: int, *,
                  optimizer: str = "adagrad", lr: float = 0.05,
                  initializer=None, seed: int = 0, mmap_dir: Optional[str] = None,
-                 async_updates: bool = False, queue_size: int = 64):
+                 async_updates: bool = False, queue_size: int = 64,
+                 row_shard=None):
         if optimizer not in ("sgd", "adagrad"):
             raise ValueError(f"host table optimizer must be sgd|adagrad, "
                              f"got {optimizer!r}")
@@ -82,27 +99,51 @@ class HostTable:
         self._seed = seed
         self._queue_size = queue_size
         self._initializer = initializer
-        shape = (self.vocab_size, self.dim)
+        # row_shard=(shard_id, n_shards): this process stores ONLY rows
+        # [lo, hi) -- the cross-process pserver row partition (reference
+        # distribute_transpiler.py:990 param blocks). Ids stay global;
+        # gather_shard/push_shard translate and filter by ownership.
+        self.row_shard = tuple(row_shard) if row_shard else None
+        if self.row_shard:
+            k, nsh = self.row_shard
+            if not (0 <= k < nsh):
+                raise ValueError(f"row_shard {self.row_shard}: shard id out "
+                                 f"of range")
+            self.row_lo, self.row_hi = self.shard_bounds(
+                self.vocab_size, nsh, k)
+        else:
+            self.row_lo, self.row_hi = 0, self.vocab_size
+        shape = (self.row_hi - self.row_lo, self.dim)
         if mmap_dir is not None:
             os.makedirs(mmap_dir, exist_ok=True)
+            # shard suffix: ranks sharing a filesystem must not open the
+            # same backing file (same reason as _ckpt_path)
+            sfx = (f".shard{self.row_shard[0]}of{self.row_shard[1]}"
+                   if self.row_shard else "")
             self.table = np.lib.format.open_memmap(
-                os.path.join(mmap_dir, f"{name}.table.npy"), mode="w+",
+                os.path.join(mmap_dir, f"{name}{sfx}.table.npy"), mode="w+",
                 dtype=np.float32, shape=shape)
             self._accum = np.lib.format.open_memmap(
-                os.path.join(mmap_dir, f"{name}.accum.npy"), mode="w+",
+                os.path.join(mmap_dir, f"{name}{sfx}.accum.npy"), mode="w+",
                 dtype=np.float32, shape=shape)
             self._accum[:] = 0.0
         else:
             self.table = np.empty(shape, np.float32)
             self._accum = np.zeros(shape, np.float32)
         rng = np.random.RandomState(seed)
+        full_shape = (self.vocab_size, self.dim)
         if initializer is None:
+            # draw the FULL table deterministically and keep the local rows:
+            # every shard layout yields the same global values for a seed
             scale = 1.0 / np.sqrt(self.dim)
-            self.table[:] = rng.uniform(-scale, scale, shape).astype(np.float32)
+            full = rng.uniform(-scale, scale, full_shape).astype(np.float32)
+            self.table[:] = full[self.row_lo:self.row_hi]
         elif callable(initializer):
-            self.table[:] = np.asarray(initializer(shape), np.float32)
+            self.table[:] = np.asarray(initializer(full_shape),
+                                       np.float32)[self.row_lo:self.row_hi]
         else:
-            self.table[:] = np.asarray(initializer, np.float32).reshape(shape)
+            self.table[:] = np.asarray(initializer, np.float32).reshape(
+                full_shape)[self.row_lo:self.row_hi]
         self._lock = threading.Lock()
         self.push_count = 0
         self._closed = False
@@ -135,7 +176,59 @@ class HostTable:
         """Lock-free read (Hogwild-style: concurrent async pushes may be
         partially visible; exact under sync mode)."""
         idx = self._check_ids(ids, "gather")
+        if self.row_shard:
+            raise RuntimeError(
+                f"host table {self.name!r} is row-sharded "
+                f"{self.row_shard}; use gather_shard (the sharded lookup "
+                f"op does) -- a plain gather cannot see remote rows")
         return self.table[idx.reshape(-1)].reshape(idx.shape + (self.dim,))
+
+    def gather_shard(self, ids: np.ndarray, shard: int,
+                     n_shards: int) -> np.ndarray:
+        """Rows for ids owned by ``shard``, zeros elsewhere; summing the
+        n_shards results reconstructs the full gather (the psum in the
+        sharded lookup op)."""
+        idx = self._check_ids(ids, "gather_shard")
+        if self.row_shard:
+            if (shard, n_shards) != self.row_shard:
+                raise RuntimeError(
+                    f"host table {self.name!r} holds row shard "
+                    f"{self.row_shard} but the mesh routed shard "
+                    f"({shard}, {n_shards}) here -- host-axis device order "
+                    f"and table row_shard disagree")
+            lo, hi = self.row_lo, self.row_hi
+        else:
+            lo, hi = self.shard_bounds(self.vocab_size, n_shards, shard)
+        flat = idx.reshape(-1)
+        owned = (flat >= lo) & (flat < hi)
+        local = np.where(owned, flat - self.row_lo
+                         if self.row_shard else flat, 0)
+        rows = self.table[local] * owned[:, None]
+        return rows.reshape(idx.shape + (self.dim,))
+
+    def push_shard(self, ids: np.ndarray, grads: np.ndarray, shard: int,
+                   n_shards: int):
+        """Apply only the grads whose rows ``shard`` owns."""
+        idx = self._check_ids(np.asarray(ids).reshape(-1), "push_shard")
+        g = np.asarray(grads, np.float32).reshape(len(idx), self.dim)
+        if self.row_shard:
+            if (shard, n_shards) != self.row_shard:
+                raise RuntimeError(
+                    f"host table {self.name!r} holds row shard "
+                    f"{self.row_shard} but got push for ({shard}, "
+                    f"{n_shards})")
+            lo, hi = self.row_lo, self.row_hi
+        else:
+            lo, hi = self.shard_bounds(self.vocab_size, n_shards, shard)
+        owned = (idx >= lo) & (idx < hi)
+        if not owned.any():
+            return
+        g = g[owned]
+        if not g.any():
+            # replica-gated zero pushes (see _host_push) and genuinely zero
+            # grads are no-op updates for sgd/adagrad: skip the host work
+            return
+        self.push(idx[owned], g)
 
     # ---- push ------------------------------------------------------------
     def push(self, ids: np.ndarray, grads: np.ndarray):
@@ -198,6 +291,16 @@ class HostTable:
 
     def _apply(self, ids, grads):
         ids = self._check_ids(np.asarray(ids).reshape(-1), "push")
+        if self.row_shard:
+            out = (ids < self.row_lo) | (ids >= self.row_hi)
+            if out.any():
+                raise IndexError(
+                    f"host table {self.name!r} (row shard {self.row_shard},"
+                    f" rows [{self.row_lo}, {self.row_hi})) got a push for "
+                    f"non-owned ids, e.g. "
+                    f"{np.unique(ids[out])[:4].tolist()}; route pushes "
+                    f"through push_shard")
+            ids = ids - self.row_lo
         g = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
         # Duplicate ids in one minibatch sum their contributions first (the
         # SelectedRows merge-add semantic) so the update matches the dense
@@ -215,22 +318,30 @@ class HostTable:
             self.push_count += 1
 
     # ---- persistence -----------------------------------------------------
+    def _ckpt_path(self, dirname: str) -> str:
+        # row-sharded tables checkpoint per shard (every rank saves/loads
+        # its own slice; no filename collision on a shared filesystem)
+        suffix = (f".shard{self.row_shard[0]}of{self.row_shard[1]}"
+                  if self.row_shard else "")
+        return os.path.join(dirname, f"host_table.{self.name}{suffix}.npz")
+
     def save(self, dirname: str):
         os.makedirs(dirname, exist_ok=True)
         self.flush()
         with self._lock:
-            np.savez(os.path.join(dirname, f"host_table.{self.name}.npz"),
+            np.savez(self._ckpt_path(dirname),
                      table=np.asarray(self.table),
                      accum=np.asarray(self._accum),
                      meta=np.array([self.lr, self.push_count]))
 
     def load(self, dirname: str):
-        data = np.load(os.path.join(dirname, f"host_table.{self.name}.npz"))
-        if data["table"].shape != (self.vocab_size, self.dim):
+        data = np.load(self._ckpt_path(dirname))
+        want = (self.row_hi - self.row_lo, self.dim)
+        if data["table"].shape != want:
             raise ValueError(
                 f"host table {self.name!r}: checkpoint shape "
-                f"{data['table'].shape} != declared "
-                f"{(self.vocab_size, self.dim)}")
+                f"{data['table'].shape} != declared {want} "
+                f"(row_shard={self.row_shard})")
         with self._lock:
             self.table[:] = data["table"]
             self._accum[:] = data["accum"]
@@ -258,7 +369,8 @@ def create_table(name: str, vocab_size: int, dim: int, **kwargs) -> HostTable:
                 f"{(t.vocab_size, t.dim)}, requested {(vocab_size, dim)}")
         existing = {"optimizer": t.optimizer, "lr": t.lr,
                     "mmap_dir": t.mmap_dir, "async_updates": t._async,
-                    "seed": t._seed, "queue_size": t._queue_size}
+                    "seed": t._seed, "queue_size": t._queue_size,
+                    "row_shard": t.row_shard}
         for k, v in kwargs.items():
             if k == "initializer":
                 if v is not None and not _same_init(v, t._initializer):
@@ -268,7 +380,8 @@ def create_table(name: str, vocab_size: int, dim: int, **kwargs) -> HostTable:
                         f"to rebuild it (its current weights would otherwise "
                         f"silently survive)")
             elif k in existing and existing[k] != (
-                    float(v) if k == "lr" else v):
+                    float(v) if k == "lr" else
+                    (tuple(v) if k == "row_shard" and v else v)):
                 raise ValueError(
                     f"host table {name!r} already exists with {k}="
                     f"{existing[k]!r}; requested {v!r}. drop_table({name!r}) "
@@ -318,7 +431,31 @@ def _host_lookup_grad_maker(op, grad_out_map):
     return [{"type": "host_push_grad",
              "inputs": {"Ids": list(op.inputs["Ids"]), "OutGrad": [g]},
              "outputs": {"Anchor@GRAD": [grad_var_name(op.inputs["Anchor"][0])]},
-             "attrs": {"table_name": op.attrs["table_name"]}}]
+             "attrs": {"table_name": op.attrs["table_name"],
+                       "shard_axis": op.attrs.get("shard_axis")}}]
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:  # pre-0.8 jax spells it check_rep
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
+def _shard_axis_size(ctx):
+    """(axis, n) when the sharded row-partition path applies, else None."""
+    ax = ctx.attr("shard_axis", None)
+    mesh = ctx.gspmd_mesh
+    if ax and mesh is not None and mesh.shape.get(ax, 1) > 1 \
+            and not ctx.abstract:
+        return ax, mesh.shape[ax]
+    return None
 
 
 @register("host_lookup_table", grad=_host_lookup_grad_maker,
@@ -328,9 +465,17 @@ def _host_lookup(ctx, ins):
 
     Anchor (a [1] device parameter) is ignored by the math; it exists so the
     backward pass has a differentiable input to hang ``host_push_grad`` on.
+
+    With attr shard_axis=<mesh axis>, the table is row-partitioned across
+    that axis (the cross-process pserver sharding, reference
+    distribute_transpiler.py:990 param blocks): a shard_map island runs one
+    callback per device -- under multi-host, per PROCESS against its local
+    row shard -- each returning its owned rows (zeros elsewhere), and a psum
+    over the axis reassembles the full minibatch.
     """
     import jax
     jnp = _jnp()
+    from jax.sharding import PartitionSpec as P
     ids = ins["Ids"][0]
     if ids.ndim > 1 and ids.shape[-1] == 1:  # lookup_table squeeze parity
         ids = ids.squeeze(-1)
@@ -339,6 +484,19 @@ def _host_lookup(ctx, ins):
     dtype = ctx.attr("dtype", "float32")
     out_struct = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,),
                                       jnp.dtype(dtype))
+    sharded = _shard_axis_size(ctx)
+    if sharded:
+        ax, n = sharded
+
+        def per_device(i):
+            sidx = jax.lax.axis_index(ax)
+            rows = jax.pure_callback(
+                lambda ii, ss: get_table(name).gather_shard(
+                    ii, int(ss), n).astype(dtype), out_struct, i, sidx)
+            return jax.lax.psum(rows, ax)
+
+        rows = _shard_map(per_device, ctx.gspmd_mesh, (P(),), P())(ids)
+        return {"Out": [rows]}
     # re-resolve by name inside the callback: a cached compiled program must
     # see the table registered at RUN time (drop_table+create_table safe)
     rows = jax.pure_callback(
@@ -354,12 +512,47 @@ def _host_push(ctx, ins):
     """
     import jax
     from jax.experimental import io_callback
+    from jax.sharding import PartitionSpec as P
     jnp = _jnp()
     ids, g = ins["Ids"][0], ins["OutGrad"][0]
     if ids.ndim > 1 and ids.shape[-1] == 1:
         ids = ids.squeeze(-1)
     name = ctx.attr("table_name")
     get_table(name)  # fail at trace time if missing
+    sharded = _shard_axis_size(ctx)
+    if sharded:
+        ax, n = sharded
+        mesh = ctx.gspmd_mesh
+        other_axes = [a for a in mesh.axis_names if a != ax]
+
+        def per_device(i, grad):
+            sidx = jax.lax.axis_index(ax)
+            # the island replicates over every NON-shard axis too; only the
+            # first replica along each pushes (the rest skip the callback
+            # entirely -- no device->host grad transfer) so each shard
+            # applies the gradient exactly once
+            primary = jnp.asarray(True)
+            for a in other_axes:
+                primary = primary & (jax.lax.axis_index(a) == 0)
+
+            def push_cb(ii, gg, ss):
+                get_table(name).push_shard(ii, gg, int(ss), n)
+                return np.zeros((1,), np.float32)
+
+            def do_push(operand):
+                ii, gg, ss = operand
+                return io_callback(push_cb,
+                                   jax.ShapeDtypeStruct((1,), jnp.float32),
+                                   ii, gg, ss, ordered=False)
+
+            token = jax.lax.cond(primary, do_push,
+                                 lambda _: jnp.zeros((1,), jnp.float32),
+                                 (i, grad, sidx))
+            return jax.lax.psum(token, ax)
+
+        token = _shard_map(per_device, ctx.gspmd_mesh, (P(), P()), P())(
+            ids, g)
+        return {"Anchor@GRAD": [token]}
 
     def push_cb(i, grad):
         # late-bound by name (see _host_lookup)

@@ -173,3 +173,56 @@ def test_out_of_range_ids_raise():
         t.push(np.array([-1]), np.ones((1, 2), np.float32))
     # in-range still works
     assert t.gather(np.array([0, 7])).shape == (2, 2)
+
+
+def test_row_sharded_lookup_matches_unsharded():
+    """row_shard_axis: the shard_map psum lookup over a 'host' axis matches
+    the plain single-table path exactly, training included (the SCOPE gap-#1
+    mechanism: per-device callbacks against row partitions; single-process
+    simulation -- the multi-host runner covers the per-process split)."""
+    import jax
+    from paddle_tpu.ops import host_table as ht
+
+    def run(sharded):
+        tname = f"rs_{'s' if sharded else 'p'}"
+        ht.drop_table(tname)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 4
+        startup.random_seed = 4
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            ids = fluid.data("ids", [4], "int64")
+            y = fluid.data("y", [1], "float32")
+            emb = fluid.layers.host_embedding(
+                ids, (32, 8), name=tname, optimizer="sgd",
+                learning_rate=0.2, seed=7,
+                row_shard_axis="host" if sharded else None)
+            pred = fluid.layers.fc(fluid.layers.reshape(emb, [-1, 32]), 1)
+            loss = fluid.layers.mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(pred, y)))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        strat = fluid.DistributedStrategy(
+            mesh_shape={"host": 2, "dp": 2},
+            data_rules=[("ids|y", ("dp",))], data_axis="dp")
+        cp = fluid.CompiledProgram(main).with_strategy(strat)
+        rng = np.random.RandomState(2)
+        truth = rng.randn(32).astype(np.float32)
+        exe = fluid.Executor()
+        out = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for _ in range(5):
+                gids = rng.randint(0, 32, (8, 4)).astype("int64")
+                gy = truth[gids].sum(1, keepdims=True).astype("float32")
+                lv, = exe.run(cp, feed={"ids": gids, "y": gy},
+                              fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(())))
+        table = np.array(ht.get_table(tname).table)
+        ht.drop_table(tname)
+        return out, table
+
+    plain_losses, plain_table = run(False)
+    shard_losses, shard_table = run(True)
+    np.testing.assert_allclose(plain_losses, shard_losses, rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(plain_table, shard_table, rtol=1e-4,
+                               atol=1e-6)

@@ -150,3 +150,30 @@ def test_two_process_host_table_is_single_pserver():
     # the pserver is process 0: it applied every step's push, rank 1 none
     assert _tagged(multi[0], "PUSHES") == 6
     assert _tagged(multi[1], "PUSHES") == 0
+
+
+def test_two_process_row_sharded_host_table():
+    """Row-sharded host tables (SCOPE gap #1 closed): each process stores
+    ONLY its row range -- the table can exceed one host's RAM -- with
+    per-process pull/push callbacks through the shard_map island; losses
+    match the 1-process (unsharded) run and BOTH ranks act as pservers."""
+    runner = os.path.join(os.path.dirname(__file__),
+                          "dist_hostemb_runner.py")
+    single = _launch(1, _free_port(), ckpt_dir="shard", runner=runner)
+    multi = _launch(2, _free_port(), ckpt_dir="shard", runner=runner)
+
+    l1 = _tagged(single[0], "LOSSES")
+    np.testing.assert_allclose(l1, _tagged(multi[0], "LOSSES"),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(l1, _tagged(multi[1], "LOSSES"),
+                               rtol=1e-4, atol=1e-5)
+    # memory is actually partitioned: 32 of 64 rows per process, disjoint
+    assert _tagged(single[0], "ROWS") == 64
+    assert _tagged(multi[0], "ROWS") == 32
+    assert _tagged(multi[1], "ROWS") == 32
+    assert _tagged(multi[0], "RANGE") == [0, 32]
+    assert _tagged(multi[1], "RANGE") == [32, 64]
+    # every host is a pserver for its slice (vs the single-pserver topology
+    # where rank 1 applies nothing)
+    assert _tagged(multi[0], "PUSHES") == 6
+    assert _tagged(multi[1], "PUSHES") == 6
