@@ -26,10 +26,11 @@ from . import imdb  # noqa: F401
 from . import conll05  # noqa: F401
 from . import movielens  # noqa: F401
 from . import wmt16  # noqa: F401
+from . import wmt14  # noqa: F401
 from . import flowers  # noqa: F401
 
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "conll05", "movielens",
-           "wmt16", "flowers", "data_home"]
+           "wmt14", "wmt16", "flowers", "data_home"]
 
 
 def data_home(name: str) -> str:
