@@ -41,6 +41,7 @@ from . import debugger  # noqa: F401
 from . import average  # noqa: F401
 from . import install_check  # noqa: F401
 from . import net_drawer  # noqa: F401
+from . import incubate  # noqa: F401
 from .flags import get_flag, set_flags  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import reader  # noqa: F401

@@ -1,0 +1,4 @@
+"""Incubate namespace (reference python/paddle/fluid/incubate/):
+fleet collective facade + the MultiSlot data generator."""
+from .. import fleet  # noqa: F401
+from . import data_generator  # noqa: F401

@@ -1,0 +1,114 @@
+"""MultiSlot data generators (reference
+python/paddle/fluid/incubate/data_generator/__init__.py:18 --
+DataGenerator / MultiSlotDataGenerator / MultiSlotStringDataGenerator).
+
+Same authoring surface as the reference: subclass, implement
+``generate_sample(line)`` yielding ``[(slot_name, [values]), ...]`` samples
+(either as a generator directly or as a callable returning one -- both
+reference styles work), optionally override ``generate_batch`` for
+batch-level transforms (it is called with each ``set_batch``-sized group),
+then ``run_from_stdin()`` in a preprocessing job or
+``run_from_files``/``run_from_memory`` locally.
+
+Output format diverges deliberately: the reference emitted its
+"<size> v v ..." MultiSlot protocol for the C++ DataFeed; here lines are the
+``dataset_factory`` text format (slots ``;``-separated, values
+space-separated, ordered as ``set_use_var``) that the native C++ parser and
+the numpy fallback both read.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Iterable, List, Tuple
+
+
+class DataGenerator(object):
+    def __init__(self):
+        self._batch = 1
+
+    def set_batch(self, batch_size):
+        """Group size handed to generate_batch (reference parity)."""
+        self._batch = max(1, int(batch_size))
+
+    # -- to be implemented by subclasses -----------------------------------
+    def generate_sample(self, line):
+        """Produce samples for one input line; each sample is
+        [(slot_name, [values...]), ...]. Write it either as a generator
+        method (``yield sample``) or return a callable yielding samples
+        (both appear in reference user code)."""
+        raise NotImplementedError(
+            "implement generate_sample(self, line) yielding "
+            "[(name, [values]), ...] samples")
+
+    def generate_batch(self, samples):
+        """Batch-level hook: receives a list of ``set_batch`` samples and
+        returns an iterable (or callable yielding) of samples to emit.
+        Override for batch transforms (shuffle, negative sampling)."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- drivers -----------------------------------------------------------
+    @staticmethod
+    def _as_iter(obj):
+        """Accept both contract styles: a callable returning an iterator, or
+        an iterator/generator itself."""
+        if obj is None:
+            return iter(())
+        return iter(obj() if callable(obj) else obj)
+
+    def _process(self, lines, write):
+        """Shared driver: line -> generate_sample -> batched generate_batch
+        -> formatted emit."""
+        buf: List = []
+
+        def flush():
+            for sample in self._as_iter(self.generate_batch(buf)):
+                write(self._gen_str(sample))
+            buf.clear()
+
+        for line in lines:
+            for sample in self._as_iter(self.generate_sample(line)):
+                buf.append(sample)
+                if len(buf) >= self._batch:
+                    flush()
+        if buf:
+            flush()
+
+    def run_from_stdin(self):
+        self._process(sys.stdin, sys.stdout.write)
+
+    def run_from_files(self, filelist, output_path):
+        """Local convenience: parse every input file into one dataset file
+        readable by DatasetFactory (set_filelist([output_path]))."""
+        with open(output_path, "w") as out:
+            for path in filelist:
+                with open(path) as f:
+                    self._process(f, out.write)
+        return output_path
+
+    def run_from_memory(self, lines=None, output_path=None):
+        """Parse in-memory lines; returns the formatted lines (and writes
+        them when output_path is given)."""
+        outs: List[str] = []
+        self._process(lines if lines is not None else [None], outs.append)
+        if output_path:
+            with open(output_path, "w") as f:
+                f.writelines(outs)
+        return outs
+
+    def _gen_str(self, sample: Iterable[Tuple[str, list]]) -> str:
+        """One output line per sample: slot values space-joined, slots
+        ';'-joined (numeric and string slots format identically here)."""
+        return ";".join(" ".join(str(v) for v in values)
+                        for _name, values in sample) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots (reference :18). Formatting lives in the base."""
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Pre-tokenized string slots (reference MultiSlotStringDataGenerator);
+    same output format, kept as a distinct type for ported code."""
