@@ -26,10 +26,11 @@ Gradient: the generic auto-vjp differentiates straight through the shard_map
 the optimizer's per-parameter state is stage-stacked too and shards over the
 same axis.
 
-RNG note: ops with PRNG draws (dropout) inside the template draw the *same*
-stream in every stage (the template's op salts). Stage-decorrelated streams
-would need the stage index folded into the key inside shard_map; until then
-prefer dropout=0 or the microbatch-scan schedule for stochastic stacks.
+RNG: ops with PRNG draws (dropout) inside the template get the step key with
+the STAGE index folded in (lax.axis_index inside the shard_map; the scan
+index on the serial path), so each stage draws an independent stream.
+Microbatches within a step share a stage's stream (the same property as the
+microbatch-scan rewrite).
 """
 from __future__ import annotations
 
@@ -50,7 +51,7 @@ def temporal_pipeline(ctx, ins):
     import jax.numpy as jnp
 
     x = ins["X"][0]
-    params = tuple(ins["Params"])
+    params = tuple(ins.get("Params", ()))
     consts = tuple(ins.get("Consts", ()))
     S = int(ctx.attr("num_stages"))
     M = int(ctx.attr("num_microbatches", 1))
@@ -87,7 +88,9 @@ def temporal_pipeline(ctx, ins):
         else:
             static_idx.append(i)
 
-    def stage_fn(stage_params, carry, static_cs):
+    base_key = ctx.rng()
+
+    def stage_fn(stage_params, carry, static_cs, stage_index):
         h = carry[0]
         env = {in_var: h}
         env.update(dict(zip(pvars, stage_params)))
@@ -95,26 +98,38 @@ def temporal_pipeline(ctx, ins):
             env[cvars[i]] = carry[1 + j]
         for j, i in enumerate(static_idx):
             env[cvars[i]] = static_cs[j]
-        out = runner(blk_idx, env)[out_var]
+        # per-stage PRNG stream: the template's op salts are shared across
+        # stages, so decorrelate by folding the stage index into the key
+        key = jax.random.fold_in(static_cs[-1], stage_index)
+        out = runner(blk_idx, env, key)[out_var]
         return (out,) + tuple(carry[1:])   # side inputs pass through
 
     xs_tree = (to_mb(x),) + tuple(to_mb(consts[i]) for i in batch_idx)
-    static_cs = tuple(consts[i] for i in static_idx)
+    # the step key rides the consts (replicated into the shard_map); the
+    # last slot is reserved for it (static_cs[-1] in stage_fn)
+    static_cs = tuple(consts[i] for i in static_idx) + (base_key,)
 
     mesh = ctx.gspmd_mesh
     on_mesh = (mesh is not None and axis in mesh.shape
                and mesh.shape[axis] == S and not ctx.abstract)
     if on_mesh:
         from ..parallel.pipeline import pipeline_spmd
+
+        def mesh_stage(p, c, cs):
+            return stage_fn(p, c, cs, jax.lax.axis_index(axis))
+
         mb_axis = "dp" if mesh.shape.get("dp", 1) > 1 else None
-        ys = pipeline_spmd(stage_fn, params, xs_tree, mesh, axis=axis,
+        ys = pipeline_spmd(mesh_stage, params, xs_tree, mesh, axis=axis,
                            consts=static_cs, mb_axis=mb_axis)[0]
     else:
         # serial schedule: same per-microbatch, per-stage math, no pipe skew
+        stage_ids = jax.numpy.arange(S)
+
         def run_mb(carry):
-            def body(c, stage_params):
-                return stage_fn(stage_params, c, static_cs), None
-            out, _ = jax.lax.scan(body, carry, params)
+            def body(c, ps):
+                stage_params, sidx = ps
+                return stage_fn(stage_params, c, static_cs, sidx), None
+            out, _ = jax.lax.scan(body, carry, (params, stage_ids))
             return out[0]
 
         ys = jax.lax.map(run_mb, xs_tree)

@@ -244,3 +244,40 @@ def test_device_guard_tags_ops():
     devs = [op.attr("op_device") for op in main.global_block().ops]
     assert "stage:0" in devs and "stage:1" in devs
     assert devs[-1] is None   # mean built outside any guard
+
+
+def test_temporal_pipeline_stage_rngs_decorrelated():
+    """Dropout inside temporal stages draws an independent stream per stage:
+    two 0.5-dropout stages keep ~25% of elements (correlated streams would
+    keep ~50%, since the second mask would equal the first)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4096], "float32")
+        h = fluid.layers.scale(x, scale=1.0)        # prologue
+        for s in range(2):
+            with fluid.device_guard(f"stage:{s}"):
+                h = fluid.layers.dropout(
+                    h, 0.5, dropout_implementation="upscale_in_train")
+        out = fluid.layers.scale(h, scale=1.0)      # epilogue
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), num_microbatches=2,
+            schedule="temporal")
+        # no params to train: just run the rewrite + forward
+        try:
+            opt.minimize(fluid.layers.mean(out))
+        except Exception:
+            pass  # no trainable params; the rewrite already happened
+    # the rewrite must actually have produced the temporal op -- otherwise
+    # plain dropout ops (distinct per-op salts) make this test vacuous
+    assert any(op.type == "temporal_pipeline"
+               for op in main.global_block().ops)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ov, = exe.run(main, feed={"x": np.ones((4, 4096), "float32")},
+                      fetch_list=[out])
+    frac = float((np.asarray(ov) != 0).mean())
+    # independent masks: keep ~0.25; correlated: ~0.5
+    assert 0.17 < frac < 0.33, frac
