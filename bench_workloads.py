@@ -70,7 +70,7 @@ def bench_transformer(batch=64, seq=64):
         # these steps are 10-30 ms: longer segments keep the relay's fixed
         # sync overhead small relative to the differential (r4: run-to-run
         # variance at the default lengths was ~15%)
-        per_step = _timed_steps(
+        per_step, _ = _timed_steps(
             lambda: exe.run(main, feed=feed, fetch_list=[],
                             return_numpy=False),
             lambda: scope.find_var("src_emb"), n_short=10, n_long=120)
@@ -108,7 +108,7 @@ def bench_deepfm(batch=4096, fields=26, vocab=1_000_000, embed=16):
             exe.run(main, feed=feed, fetch_list=[], return_numpy=False)
         scope = fluid.global_scope()
         _sync(scope.find_var("fm_v"))
-        per_step = _timed_steps(
+        per_step, _ = _timed_steps(
             lambda: exe.run(main, feed=feed, fetch_list=[],
                             return_numpy=False),
             lambda: scope.find_var("fm_v"), n_short=10, n_long=120)

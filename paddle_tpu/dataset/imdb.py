@@ -80,11 +80,13 @@ def _docs(split):
 
 
 def build_dict(docs, cutoff=_CUTOFF):
+    """{word: idx} dropping words with freq <= cutoff (reference :41 semantics),
+    then capped at _VOCAB-1 entries by frequency (TPU-side fixed-vocab cap)."""
     freq = {}
     for words, _ in docs:
         for w in words:
             freq[w] = freq.get(w, 0) + 1
-    kept = [w for w, c in freq.items() if c > 0]
+    kept = [w for w, c in freq.items() if c > cutoff]
     kept.sort(key=lambda w: (-freq[w], w))
     kept = kept[:_VOCAB - 1]
     word_idx = {w: i for i, w in enumerate(kept)}
@@ -93,8 +95,14 @@ def build_dict(docs, cutoff=_CUTOFF):
 
 
 def word_dict():
-    """{word: idx} over the train split, '<unk>' last (reference :131)."""
-    return build_dict(_docs("train"))
+    """{word: idx} over the train split, '<unk>' last (reference :131).
+
+    The reference cutoff (150) applies to the real aclImdb corpus; the
+    synthetic corpus keeps every word (its topical words have freq ~100 by
+    construction, so the real-data cutoff would empty the signal vocabulary).
+    """
+    cutoff = _CUTOFF if _find_real() is not None else 0
+    return build_dict(_docs("train"), cutoff=cutoff)
 
 
 def _reader_creator(split, word_idx):

@@ -123,22 +123,28 @@ def encoder_layer(x, cfg: BertConfig, mask_bias, name):
 def encoder(src_ids, pos_ids, sent_ids, input_mask, cfg: BertConfig):
     """Embeddings + transformer stack. input_mask: [B,S] 1/0 float.
 
-    Embedding tables are created in cfg.dtype: on TPU the whole encoder
-    (and the tied MLM decode) then runs bf16 end-to-end -- layer_norm and
-    softmax still accumulate in f32 inside their ops."""
+    Master-weight convention (the reference AMP pattern,
+    contrib/mixed_precision): embedding tables are ALWAYS created f32 so
+    their Adam state stays f32 -- small updates don't round to zero in bf16
+    over long runs. Activations are cast to cfg.dtype right after the
+    embedding sum (the cast fuses into the gather), so the encoder still
+    runs bf16 end-to-end on TPU; layer_norm and softmax accumulate in f32
+    inside their ops regardless."""
     emb = layers.embedding(src_ids, [cfg.vocab_size, cfg.hidden],
-                           dtype=cfg.dtype,
+                           dtype="float32",
                            param_attr=ParamAttr(name="word_emb",
                                                 initializer=Normal(0.0, 0.02)))
     pos = layers.embedding(pos_ids, [cfg.max_seq_len, cfg.hidden],
-                           dtype=cfg.dtype,
+                           dtype="float32",
                            param_attr=ParamAttr(name="pos_emb",
                                                 initializer=Normal(0.0, 0.02)))
     sent = layers.embedding(sent_ids, [cfg.type_vocab, cfg.hidden],
-                            dtype=cfg.dtype,
+                            dtype="float32",
                             param_attr=ParamAttr(name="sent_emb",
                                                  initializer=Normal(0.0, 0.02)))
     x = layers.elementwise_add(layers.elementwise_add(emb, pos), sent)
+    if cfg.dtype != "float32":
+        x = layers.cast(x, cfg.dtype)
     x = layers.layer_norm(x, begin_norm_axis=2)
     if cfg.dropout:
         x = layers.dropout(x, cfg.dropout,
@@ -186,17 +192,24 @@ def pretrain(src_ids, pos_ids, sent_ids, input_mask, mask_pos, mask_label,
     if cfg.tie_mlm_weight:
         from ..framework import default_main_program
         word_emb = default_main_program().global_block().var("word_emb")
-        mlm_logits = layers.matmul(mlm_h, word_emb, transpose_y=True)
+        # the table is f32 (master-weight convention); cast it down so the
+        # [M,H]x[H,V] decode -- the largest matmul in the step -- runs at the
+        # MXU's bf16 rate. The f32 param still carries the optimizer state.
+        wdec = word_emb if cfg.dtype == "float32" else \
+            layers.cast(word_emb, cfg.dtype)
+        mlm_logits = layers.matmul(mlm_h, wdec, transpose_y=True)
+        if cfg.dtype == "bfloat16":
+            mlm_logits = layers.cast(mlm_logits, "float32")
         mlm_bias = tensor_layers.create_parameter(
-            [cfg.vocab_size], cfg.dtype, name="mlm_out_bias",
+            [cfg.vocab_size], "float32", name="mlm_out_bias",
             default_initializer=Constant(0.0))
         mlm_logits = layers.elementwise_add(mlm_logits, mlm_bias)
     else:
         mlm_logits = layers.fc(mlm_h, cfg.vocab_size,
                                param_attr=ParamAttr(name="mlm_out_w",
                                                     initializer=Normal(0.0, 0.02)))
-    if cfg.dtype == "bfloat16":
-        mlm_logits = layers.cast(mlm_logits, "float32")
+        if cfg.dtype == "bfloat16":
+            mlm_logits = layers.cast(mlm_logits, "float32")
     mlm_loss = layers.mean(
         layers.softmax_with_cross_entropy(mlm_logits, mask_label))
 

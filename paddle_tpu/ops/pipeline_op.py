@@ -80,13 +80,26 @@ def temporal_pipeline(ctx, ins):
     # Consts whose leading dim is the batch (attention mask bias) are
     # per-example: they are microbatched and ride the carried pytree through
     # the pipe so each stage sees the slice matching its current microbatch.
-    # Scalar/stage-invariant consts replicate.
+    # Scalar/stage-invariant consts replicate. The rewriter classifies this
+    # statically from declared shapes (attrs batch_const_vars /
+    # defer_const_vars); the runtime shape heuristic applies only to vars the
+    # declared shapes couldn't decide (defer) and to hand-built ops without
+    # the attrs. A batch-classified const whose runtime leading dim is not
+    # the batch is a hard error, not silent mis-slicing.
+    batch_names = ctx.attr("batch_const_vars", None)
+    defer_names = set(ctx.attr("defer_const_vars", []) or [])
     batch_idx, static_idx = [], []
     for i, c in enumerate(consts):
-        if getattr(c, "ndim", 0) >= 1 and c.shape[0] == B:
-            batch_idx.append(i)
+        if batch_names is None or cvars[i] in defer_names:
+            riding = getattr(c, "ndim", 0) >= 1 and c.shape[0] == B
         else:
-            static_idx.append(i)
+            riding = cvars[i] in batch_names
+            if riding and (getattr(c, "ndim", 0) < 1 or c.shape[0] != B):
+                raise ValueError(
+                    f"temporal_pipeline: const {cvars[i]!r} was classified "
+                    f"batch-riding from its declared shape but has runtime "
+                    f"leading dim {getattr(c, 'shape', ())} != batch {B}")
+        (batch_idx if riding else static_idx).append(i)
 
     base_key = ctx.rng()
 

@@ -730,6 +730,34 @@ def _rewrite_temporal_pipeline(program: Program, startup, M, axis="pp",
     if len(shapes) != 1:
         return bail(f"cut activations must share one shape, found {shapes}")
 
+    # classify consts statically: per-example (batch-riding, microbatched by
+    # the op) vs stage-invariant (replicated). Recording this as an op attr
+    # here -- where declared shapes are known -- avoids the runtime
+    # shape-coincidence trap (a stage-invariant const whose dim 0 happens to
+    # equal the batch). Three-way result:
+    #   batch:  leading dim is the dynamic batch mark (-1) like the
+    #           activation's, or concretely equals the activation's concrete
+    #           batch dim;
+    #   static: concrete leading dim that differs from the batch dim;
+    #   defer:  declared shapes can't decide (one side -1, the other
+    #           concrete) -- the op falls back to its runtime heuristic for
+    #           just that var.
+    act_lead = tuple(block.var(in_var).shape)[0] if block.var(in_var).shape \
+        else None
+
+    def _classify(n):
+        shp = tuple(block.var(n).shape)
+        if not shp:
+            return "static"
+        if shp[0] == -1:
+            return "batch" if act_lead == -1 else "defer"
+        if act_lead == -1:
+            return "defer"
+        return "batch" if shp[0] == act_lead else "static"
+
+    batch_const_vars = [n for n in const_vars if _classify(n) == "batch"]
+    defer_const_vars = [n for n in const_vars if _classify(n) == "defer"]
+
     # ---- build: template sub-block + stacked params + the pipeline op ------
     sub = program._create_block(parent_idx=0)
     program._rollback()
@@ -768,7 +796,9 @@ def _rewrite_temporal_pipeline(program: Program, startup, M, axis="pp",
                "num_microbatches": max(M, 1), "axis": axis,
                "in_var": in_var, "template_out": cuts[0],
                "param_vars": list(stage_params[0]),
-               "const_vars": const_vars},
+               "const_vars": const_vars,
+               "batch_const_vars": batch_const_vars,
+               "defer_const_vars": defer_const_vars},
         infer_shape=False)
     block.ops.extend(epilogue)
     return True

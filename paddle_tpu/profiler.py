@@ -184,20 +184,27 @@ def merge_chrome_traces(paths, output_path: str = "timeline.json") -> str:
     import json
 
     merged = {"traceEvents": []}
+    # cumulative offsets: each input's range starts past the previous input's
+    # max pid, so re-merging an already-merged timeline (pids >= 100000)
+    # cannot collide with a later input's range.
+    offset = 0
     for i, p in enumerate(paths):
         op = gzip.open(p, "rt") if str(p).endswith(".gz") else open(p)
         with op as f:
             t = json.load(f)
-        offset = (i + 1) * 100000
-        for e in t.get("traceEvents", []):
+        events = t.get("traceEvents", [])
+        pids = [int(e["pid"]) for e in events if "pid" in e]
+        base = offset - min(pids) if pids else offset
+        for e in events:
             e = dict(e)
             if "pid" in e:
-                e["pid"] = offset + int(e["pid"])
+                e["pid"] = base + int(e["pid"])
             if e.get("ph") == "M" and e.get("name") == "process_name":
                 e.setdefault("args", {})
                 e["args"]["name"] = (f"proc{i}: "
                                      f"{e['args'].get('name', '')}")
             merged["traceEvents"].append(e)
+        offset = base + (max(pids) if pids else 0) + 1
     with open(output_path, "w") as f:
         json.dump(merged, f)
     return output_path

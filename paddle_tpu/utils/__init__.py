@@ -1,3 +1,4 @@
 from . import flops  # noqa: F401
-from .flops import program_flops, device_peak_flops  # noqa: F401
+from .flops import (program_flops, device_peak_flops,  # noqa: F401
+                    device_peak_hbm_bw, device_peak_ici_bw, bandwidth_sanity)
 from .checkpointer import Checkpointer  # noqa: F401

@@ -33,6 +33,65 @@ def device_peak_flops(device_kind: str) -> Optional[float]:
     return _PEAK_BF16.get(device_kind)
 
 
+# HBM bandwidth peaks, bytes/s per *JAX device* (v2/v3 report per-core
+# devices -> half the chip's HBM). Public spec-sheet numbers.
+_PEAK_HBM = {
+    "TPU v2": 350e9,
+    "TPU v3": 450e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+# ICI egress per chip, bytes/s (one-way link bandwidth x link count on the
+# torus; the scaling-book numbers). Upper bounds for sanity checks -- an
+# allreduce bus bandwidth over ICI cannot exceed this.
+_PEAK_ICI = {
+    "TPU v2": 200e9,
+    "TPU v3": 280e9,
+    "TPU v4": 270e9,
+    "TPU v5 lite": 180e9,
+    "TPU v5e": 180e9,
+    "TPU v5": 540e9,
+    "TPU v5p": 540e9,
+    "TPU v6 lite": 360e9,
+    "TPU v6e": 360e9,
+}
+
+
+def device_peak_hbm_bw(device_kind: str) -> Optional[float]:
+    """Peak HBM bytes/s for a jax device kind, or None if unknown."""
+    return _PEAK_HBM.get(device_kind)
+
+
+def device_peak_ici_bw(device_kind: str) -> Optional[float]:
+    """Peak per-chip ICI egress bytes/s, or None if unknown."""
+    return _PEAK_ICI.get(device_kind)
+
+
+def bandwidth_sanity(value_gbps: float, device_kind: str, domain: str):
+    """Clamp a measured bandwidth against the chip's physical peak.
+
+    domain: "hbm" or "ici". Returns (reported_gbps, suspect, bound_gbps).
+    A timing-differencing estimator fed noisy segment times can produce a
+    tiny positive delta and an impossible bandwidth (round-4 postmortem:
+    5,832 GB/s "HBM" on a chip whose HBM peaks at 819); any estimate above
+    the physical peak is reported AS the peak with suspect=True so an
+    impossible number can never be recorded as a measurement.
+    """
+    peak = (_PEAK_HBM if domain == "hbm" else _PEAK_ICI).get(device_kind)
+    if peak is None:
+        return value_gbps, False, None
+    bound = peak / 1e9
+    if value_gbps > bound:
+        return bound, True, bound
+    return value_gbps, False, bound
+
+
 def _subst(shape, batch):
     return tuple(batch if d == -1 else int(d) for d in shape)
 

@@ -163,3 +163,51 @@ def test_data_generator_batch_hook_and_generator_style(tmp_path):
     g.set_batch(2)
     outs = g.run_from_memory(lines=["1", "2", "3", "4", "5"])
     assert outs == ["2;0\n", "1;0\n", "4;0\n", "3;0\n", "5;0\n"]
+
+
+def test_conll05_props_parser(tmp_path, monkeypatch):
+    """The cached-corpus branch (ADVICE r4): a words/props pair in the data
+    home is parsed from the bracketed-span column format into BIO labels,
+    one sample per predicate, and test() yields the 9-slot SRL tuple."""
+    from paddle_tpu.dataset import conll05
+
+    words = "The cat sat .\nDogs bark .\n".replace(" ", "\n")
+    # sentence 1: one predicate (sat): (A0* ... *) spans; sentence 2: bark
+    props1 = ["-  (A0*", "-  *)", "sat  (V*)", "-  *"]
+    props2 = ["-  (A0*)", "bark  (V*)", "-  *"]
+    (tmp_path / "test.wsj.words").write_text(
+        "The\ncat\nsat\n.\n\nDogs\nbark\n.\n")
+    (tmp_path / "test.wsj.props").write_text(
+        "\n".join(props1) + "\n\n" + "\n".join(props2) + "\n")
+    monkeypatch.setattr(conll05, "_home", lambda: str(tmp_path))
+
+    samples = conll05._real_corpus(str(tmp_path / "test.wsj.words"),
+                                   str(tmp_path / "test.wsj.props"))
+    assert len(samples) == 2
+    w0, vpos0, lemma0, bio0 = samples[0]
+    assert w0 == ["The", "cat", "sat", "."] and vpos0 == 2
+    assert lemma0 == "sat" and bio0 == ["B-A0", "I-A0", "B-V", "O"]
+    w1, vpos1, lemma1, bio1 = samples[1]
+    assert bio1 == ["B-A0", "B-V", "O"] and vpos1 == 1
+
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    assert "sat" in verb_dict and "B-A0" in label_dict
+    rows = list(conll05.test()())
+    assert len(rows) == 2
+    sent, c2, c1, c0, p1, p2, verbs, mark, labels = rows[0]
+    n = len(sent)
+    assert all(len(s) == n for s in (c2, c1, c0, p1, p2, verbs, mark, labels))
+    assert mark[vpos0] == 1 and sum(mark) == 1
+    assert c0 == [sent[vpos0]] * n  # predicate context broadcast
+
+
+def test_imdb_cutoff_semantics():
+    """ADVICE r4: build_dict drops words with freq <= cutoff (the reference
+    imdb.py:41 rule); the synthetic path keeps every word (cutoff 0)."""
+    from paddle_tpu.dataset import imdb
+
+    docs = [(["a"] * 5 + ["b"] * 2 + ["c"], 1)]
+    d = imdb.build_dict(docs, cutoff=2)
+    assert "a" in d and "b" not in d and "c" not in d and "<unk>" in d
+    d0 = imdb.build_dict(docs, cutoff=0)
+    assert "a" in d0 and "b" in d0 and "c" in d0  # freq > 0: all kept
