@@ -2,4 +2,6 @@ from . import mixed_precision  # noqa: F401
 from . import quantize  # noqa: F401
 from . import extend_optimizer  # noqa: F401
 from . import slim  # noqa: F401
+from . import fuse_conv_bn  # noqa: F401
+from .fuse_conv_bn import fuse_conv_bn_stats  # noqa: F401
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401

@@ -195,8 +195,14 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",
                in_place=False, name=None, moving_mean_name=None,
                moving_variance_name=None, do_model_average_for_mean_and_var=False,
-               use_global_stats=False):
-    """Reference nn.py:4104."""
+               use_global_stats=False, fuse_stats=False):
+    """Reference nn.py:4104.
+
+    fuse_stats=True marks this BN for contrib.fuse_conv_bn_stats (the
+    ir/conv_bn_fuse_pass.cc analog): when its input is a 1x1/s1 NHWC conv,
+    the pass swaps the pair for the Pallas conv2d_bn_fused op whose epilogue
+    accumulates the statistics. Off by default -- on v5e the measured XLA
+    fusion is at least as fast (ops/pallas_conv_bn.py docstring)."""
     from ..initializer import Constant
     helper = LayerHelper("batch_norm", act=act, name=name)
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
@@ -221,7 +227,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                  "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
         attrs={"momentum": momentum, "epsilon": epsilon,
                "is_test": is_test, "data_layout": data_layout,
-               "use_global_stats": use_global_stats})
+               "use_global_stats": use_global_stats,
+               "fuse_stats": fuse_stats})
     return helper.append_activation(_var(helper, y))
 
 

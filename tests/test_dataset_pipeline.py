@@ -171,7 +171,6 @@ def test_conll05_props_parser(tmp_path, monkeypatch):
     one sample per predicate, and test() yields the 9-slot SRL tuple."""
     from paddle_tpu.dataset import conll05
 
-    words = "The cat sat .\nDogs bark .\n".replace(" ", "\n")
     # sentence 1: one predicate (sat): (A0* ... *) spans; sentence 2: bark
     props1 = ["-  (A0*", "-  *)", "sat  (V*)", "-  *"]
     props2 = ["-  (A0*)", "bark  (V*)", "-  *"]
