@@ -603,7 +603,7 @@ class Program:
 
 
 _IS_TEST_OPS = {"dropout", "batch_norm", "sync_batch_norm", "lrn",
-                "fused_attention"}
+                "fused_attention", "conv2d_bn_fused"}
 
 
 # --------------------------------------------------------------------------------------

@@ -40,8 +40,10 @@ import numpy as np
 
 # block sizes: BM rows of the flattened [N*H*W, C] activation per grid step.
 # dtype-minor tiling wants BM % 16 == 0 (bf16 sublanes); 448 = 16*28 divides
-# every ResNet-50 stage M at batch multiples of 16 and keeps the x-block
-# (448 x 2048 bf16 = 1.8 MB) + weight block well inside VMEM.
+# every ResNet-50 stage M at batch multiples of 64 (the 7x7 stage's
+# M = batch*49 needs batch % 64 == 0; smaller batches fall back to XLA for
+# that stage via supports_fused) and keeps the x-block (448 x 2048 bf16 =
+# 1.8 MB) + weight block well inside VMEM.
 BM = 448
 BN_MAX = 512
 
@@ -52,7 +54,12 @@ def _kernel(x_ref, mu_ref, inv_ref, g_ref, b_ref, w_ref,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(0)
+    # grid is (N-blocks, M-blocks) with the M dim INNERMOST: the stat output
+    # block (0, j) is then revisited on consecutive grid steps, which is the
+    # only case where Pallas TPU preserves an output block's VMEM contents
+    # across revisits (j-fastest order would interleave other blocks between
+    # visits and the += would accumulate into stale data for N > one block).
+    i = pl.program_id(1)
     x = x_ref[...].astype(jnp.float32)
     if apply_in_bn:
         x = (x - mu_ref[...]) * inv_ref[...] * g_ref[...] + b_ref[...]
@@ -104,20 +111,23 @@ def fused_conv1x1_bn_fwd(x2, w, mu, var, gamma, beta, eps=1e-5,
     inv2 = jax.lax.rsqrt(jnp.reshape(var.astype(jnp.float32), (1, K)) + eps)
     g2 = jnp.reshape(gamma.astype(jnp.float32), (1, K))
     b2 = jnp.reshape(beta.astype(jnp.float32), (1, K))
-    grid = (M // BM, N // bn)
+    # (N-blocks, M-blocks): M innermost so the (0, j) stat blocks are
+    # revisited consecutively (see _kernel); the weight block (0, j) is
+    # fetched once per j, the x stream repeats N//bn times (1x for N<=512)
+    grid = (N // bn, M // BM)
     kern = functools.partial(_kernel, apply_in_bn=apply_in_bn,
                              relu_in=relu_in)
     y, s, ss = pl.pallas_call(
         kern, grid=grid,
-        in_specs=[pl.BlockSpec((BM, K), lambda i, j: (i, 0)),
-                  pl.BlockSpec((1, K), lambda i, j: (0, 0)),
-                  pl.BlockSpec((1, K), lambda i, j: (0, 0)),
-                  pl.BlockSpec((1, K), lambda i, j: (0, 0)),
-                  pl.BlockSpec((1, K), lambda i, j: (0, 0)),
-                  pl.BlockSpec((K, bn), lambda i, j: (0, j))],
-        out_specs=[pl.BlockSpec((BM, bn), lambda i, j: (i, j)),
-                   pl.BlockSpec((1, bn), lambda i, j: (0, j)),
-                   pl.BlockSpec((1, bn), lambda i, j: (0, j))],
+        in_specs=[pl.BlockSpec((BM, K), lambda j, i: (i, 0)),
+                  pl.BlockSpec((1, K), lambda j, i: (0, 0)),
+                  pl.BlockSpec((1, K), lambda j, i: (0, 0)),
+                  pl.BlockSpec((1, K), lambda j, i: (0, 0)),
+                  pl.BlockSpec((1, K), lambda j, i: (0, 0)),
+                  pl.BlockSpec((K, bn), lambda j, i: (0, j))],
+        out_specs=[pl.BlockSpec((BM, bn), lambda j, i: (i, j)),
+                   pl.BlockSpec((1, bn), lambda j, i: (0, j)),
+                   pl.BlockSpec((1, bn), lambda j, i: (0, j))],
         out_shape=[jax.ShapeDtypeStruct((M, N), x2.dtype),
                    jax.ShapeDtypeStruct((1, N), jnp.float32),
                    jax.ShapeDtypeStruct((1, N), jnp.float32)],
@@ -236,6 +246,27 @@ def conv2d_bn_fused(ctx, ins):
     M = B * H * W_
     x2 = x.reshape(M, C)
     w2 = jnp.transpose(w.reshape(O, C), (1, 0))
+    is_test = (ctx.attr("is_test", False)
+               or ctx.attr("use_global_stats", False))
+
+    if is_test:
+        # inference (clone(for_test=True)): normalize with the RUNNING
+        # statistics, never update them -- no stats epilogue needed, so the
+        # plain XLA dot is the whole kernel (batch_norm op semantics)
+        y2 = jax.lax.dot_general(x2, w2, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32
+                                 ).astype(x.dtype)
+        inv = jax.lax.rsqrt(var_in.astype(jnp.float32) + eps)
+        out = (y2.astype(jnp.float32) - mean_in) * inv
+        out = out * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        if act == "relu":
+            out = jnp.maximum(out, 0.0)
+        elif act:
+            raise NotImplementedError(f"conv2d_bn_fused: act={act!r}")
+        sg = jax.lax.stop_gradient
+        return {"Y": [out.astype(x.dtype).reshape(B, H, W_, O)],
+                "MeanOut": [sg(mean_in)], "VarianceOut": [sg(var_in)],
+                "SavedMean": [sg(mean_in)], "SavedVariance": [sg(inv)]}
 
     is_tpu = jax.default_backend() == "tpu"
     if supports_fused(M, C, O) and not ctx.abstract:

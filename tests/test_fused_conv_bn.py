@@ -50,13 +50,15 @@ def test_kernel_matches_composed(apply_in_bn, relu_in):
 
 def test_kernel_covers_nondivisor_of_block_n():
     """N=640 (not a multiple of the 512 max block) must still write every
-    output column: the block size falls back to a 128-multiple divisor."""
+    output column AND accumulate correct statistics: with M=2*BM both grid
+    dims exceed one block, so the stat blocks are revisited -- the case that
+    requires the M-innermost grid order (consecutive revisits)."""
     import jax.numpy as jnp
     from paddle_tpu.ops.pallas_conv_bn import (fused_conv1x1_bn,
                                                supports_fused, BM)
 
     rng = np.random.RandomState(2)
-    M, K, N = BM, 128, 640
+    M, K, N = 2 * BM, 128, 640
     assert supports_fused(M, K, N)
     x2 = jnp.asarray(rng.randn(M, K), jnp.float32)
     w = jnp.asarray(rng.randn(K, N) * 0.05, jnp.float32)
@@ -66,6 +68,8 @@ def test_kernel_covers_nondivisor_of_block_n():
     yr, sr, ssr = _composed(x2, w, z, z + 1.0, z, z, 1e-5, False, False)
     np.testing.assert_allclose(np.asarray(y), np.asarray(yr), rtol=2e-4,
                                atol=2e-4)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr), rtol=2e-3,
+                               atol=1e-2)
     np.testing.assert_allclose(np.asarray(ss), np.asarray(ssr), rtol=2e-3)
 
 
@@ -155,6 +159,44 @@ def test_fuse_pass_loss_parity():
     unfused = _run_steps(False)
     fused = _run_steps(True)
     np.testing.assert_allclose(fused, unfused, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_op_clone_for_test():
+    """clone(for_test=True) must flip the fused op to inference semantics:
+    normalize with the RUNNING statistics and leave them untouched."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [4, 4, 8], "float32")
+        h = fluid.layers.conv2d(img, 128, 1, bias_attr=False,
+                                data_format="NHWC")
+        out = fluid.layers.batch_norm(h, data_layout="NHWC",
+                                      fuse_stats=True)
+        from paddle_tpu.contrib import fuse_conv_bn_stats
+        assert fuse_conv_bn_stats(main) == 1
+    test_prog = main.clone(for_test=True)
+    fused_ops = [o for o in test_prog.global_block().ops
+                 if o.type == "conv2d_bn_fused"]
+    assert fused_ops and fused_ops[0].attr("is_test") is True
+
+    # the running mean rides the fused op's Mean input (created by the
+    # batch_norm layer as <prefix>.global_0)
+    mean_name = fused_ops[0].inputs["Mean"][0]
+    rng = np.random.RandomState(3)
+    feed = {"img": rng.randn(8, 4, 4, 8).astype(np.float32)}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        mean0 = np.array(fluid.global_scope().find_var(mean_name))
+        # train step updates the running stats; the cloned test program
+        # must NOT (and must normalize with the running values)
+        exe.run(main, feed=feed, fetch_list=[out])
+        mean1 = np.array(fluid.global_scope().find_var(mean_name))
+        assert not np.allclose(mean0, mean1)
+        exe.run(test_prog, feed=feed, fetch_list=[out])
+        mean2 = np.array(fluid.global_scope().find_var(mean_name))
+        np.testing.assert_allclose(mean2, mean1)
 
 
 def test_fuse_pass_skips_ineligible():
