@@ -232,7 +232,15 @@ class InMemoryDataset(DatasetBase):
 
 
 class QueueDataset(DatasetBase):
-    """Reference dataset.py:700: streaming variant (no load_into_memory)."""
+    """Reference dataset.py:700: streaming variant (no load_into_memory).
+
+    _iter_batches really streams: each file is parsed as it is reached and
+    its batches yielded immediately, so the executor's prefetch thread
+    (core/executor.py:_prefetch_batches) overlaps file k+1's parse with
+    file k's device steps -- the reference QueueDataset's whole purpose
+    (data_feed.cc MultiSlotDataFeed queues). Row remainders carry across
+    file boundaries so batching matches the eager path exactly.
+    """
 
     def local_shuffle(self):
         raise ValueError("QueueDataset streams files; use InMemoryDataset "
@@ -240,6 +248,97 @@ class QueueDataset(DatasetBase):
 
     def global_shuffle(self, fleet=None):
         raise ValueError("QueueDataset streams files; use InMemoryDataset")
+
+    def _iter_batches(self):
+        if self._samples is not None:   # pre-loaded (tests): eager path
+            yield from DatasetBase._iter_batches(self)
+            return
+        names = [v.name for v in self.use_vars]
+        bs = self.batch_size
+        stripe = self._stripe
+        row_base = 0                      # global row counter for striping
+        rows_kept = 0                     # post-stripe rows on this host
+        pend: Optional[List[np.ndarray]] = None   # carried columnar rows
+        pend_rows: list = []                      # carried python rows
+        columnar_mode = None
+
+        def flush(cols_or_rows, columnar, final=False):
+            nonlocal pend, pend_rows
+            if columnar:
+                cols = cols_or_rows
+                if pend is not None:
+                    cols = [np.concatenate([p, c])
+                            for p, c in zip(pend, cols)]
+                n = cols[0].shape[0]
+                stop = n if final else (n // bs) * bs
+                for i in range(0, stop, bs):
+                    if stop - i < bs and self.drop_last:
+                        break
+                    yield {nm: c[i:i + bs] for nm, c in zip(names, cols)}
+                pend = None if final else [c[stop:] for c in cols]
+            else:
+                rows = pend_rows + cols_or_rows
+                stop = len(rows) if final else (len(rows) // bs) * bs
+                for i in range(0, stop, bs):
+                    if stop - i < bs and self.drop_last:
+                        break
+                    batch = rows[i:i + bs]
+                    cols = list(zip(*batch))
+                    yield {nm: np.stack([np.asarray(x) for x in c])
+                           for nm, c in zip(names, cols)}
+                pend_rows = [] if final else rows[stop:]
+
+        n_yielded = 0
+
+        def counting(gen):
+            nonlocal n_yielded
+            for b in gen:
+                n_yielded += 1
+                yield b
+
+        for fi, path in enumerate(self.filelist):
+            if not os.path.exists(path):
+                raise FileNotFoundError(f"dataset file {path!r} not found")
+            native = self._read_native(path)
+            if native is not None:
+                cols, columnar = native, True
+            else:
+                rows = []
+                with open(path) as f:
+                    for line in f:
+                        if line.strip():
+                            rows.append(self._parse_line(line))
+                cols, columnar = rows, False
+            if columnar_mode is None:
+                columnar_mode = columnar
+            elif columnar_mode != columnar:
+                # mixed native/python files: demote the carried columnar
+                # remainder to rows so batching stays exact
+                if columnar and not columnar_mode:
+                    cols = list(zip(*[list(c) for c in cols]))
+                    columnar = False
+                else:
+                    if pend is not None:
+                        pend_rows = list(zip(*[list(c) for c in pend]))
+                        pend = None
+                    columnar_mode = False
+            n = (cols[0].shape[0] if columnar else len(cols))
+            if stripe is not None:
+                r, w = stripe
+                keep = np.arange(n)[(row_base + np.arange(n)) % w == r]
+                cols = ([c[keep] for c in cols] if columnar
+                        else [cols[int(k)] for k in keep])
+                rows_kept += len(keep)
+            else:
+                rows_kept += n
+            row_base += n
+            final = fi == len(self.filelist) - 1
+            yield from counting(flush(cols, columnar_mode, final=final))
+        if n_yielded == 0:
+            import warnings
+            warnings.warn(
+                f"Dataset yields no batches: {rows_kept} samples on this "
+                f"host vs batch_size={bs}", UserWarning)
 
 
 class DatasetFactory:

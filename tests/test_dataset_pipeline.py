@@ -210,3 +210,152 @@ def test_imdb_cutoff_semantics():
     assert "a" in d and "b" not in d and "c" not in d and "<unk>" in d
     d0 = imdb.build_dict(docs, cutoff=0)
     assert "a" in d0 and "b" in d0 and "c" in d0  # freq > 0: all kept
+
+
+class _SlowDataset:
+    """Feed-bound dataset stub: each batch costs parse_s of host time (the
+    executor only uses _iter_batches, like the reference's DataFeed)."""
+
+    def __init__(self, batches, parse_s):
+        self.batches = batches
+        self.parse_s = parse_s
+        self.thread_num = 0
+
+    def _iter_batches(self):
+        import time
+        for b in self.batches:
+            time.sleep(self.parse_s)
+            yield b
+
+
+def _feed_bound_rig(width=768, n_batches=10, bs=256):
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [width], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = x
+        for _ in range(4):
+            h = fluid.layers.fc(h, width, act="relu")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, 10), label))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    batches = [{"x": rng.randn(bs, width).astype(np.float32),
+                "label": rng.randint(0, 10, (bs, 1)).astype(np.int64)}
+               for _ in range(n_batches)]
+    return main, startup, loss, batches
+
+
+def test_train_from_dataset_overlaps_parse_and_compute():
+    """VERDICT r4 #5: epoch time must approach max(parse, compute), not
+    their sum -- the prefetch thread runs the dataset generator ahead of
+    the device loop."""
+    import time
+
+    main, startup, loss, batches = _feed_bound_rig()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # calibrate: pure compute time per step (warm, no parse cost)
+        for b in batches[:2]:
+            exe.run(main, feed=b, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for b in batches:
+            exe.run(main, feed=b, fetch_list=[loss])
+        compute_total = time.perf_counter() - t0
+    parse_s = max(0.02, compute_total / len(batches))  # feed ~ compute
+    ds = _SlowDataset(batches, parse_s)
+    parse_total = parse_s * len(batches)
+
+    exe2 = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup)
+        exe2.run(main, feed=batches[0], fetch_list=[loss])  # compile warm
+        t0 = time.perf_counter()
+        exe2.train_from_dataset(main, dataset=ds, fetch_list=[loss])
+        wall = time.perf_counter() - t0
+    serial = parse_total + compute_total
+    # with parse ~= compute, full overlap halves the epoch; require >=25%
+    # savings to stay robust under CI timing noise
+    assert wall < 0.75 * serial, (wall, parse_total, compute_total)
+
+
+def test_train_from_dataset_prefetch_preserves_order_and_errors():
+    """Single prefetch worker: batch order (and thus the final weights) is
+    identical to the synchronous loop; generator errors surface."""
+    main, startup, loss, batches = _feed_bound_rig(width=64, n_batches=6,
+                                                   bs=32)
+    def final_w(run_via_dataset):
+        # per-program PRNG run counters advance across calls; reset so both
+        # runs see identical init and per-step keys
+        main._rng_run_counter = 0
+        startup._rng_run_counter = 0
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            if run_via_dataset:
+                exe.train_from_dataset(main,
+                                       dataset=_SlowDataset(batches, 0.0),
+                                       fetch_list=[loss])
+            else:
+                for b in batches:
+                    exe.run(main, feed=b, fetch_list=[loss])
+            return np.asarray(fluid.global_scope().find_var("fc_0.w_0"))
+
+    np.testing.assert_allclose(final_w(True), final_w(False))
+
+    class _Boom(_SlowDataset):
+        def _iter_batches(self):
+            yield batches[0]
+            raise RuntimeError("parse exploded")
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="parse exploded"):
+            exe.train_from_dataset(main, dataset=_Boom(batches, 0.0),
+                                   fetch_list=[loss])
+
+
+def test_queue_dataset_streaming_matches_eager(tmp_path):
+    """QueueDataset's streaming _iter_batches (per-file parse, remainder
+    carry, striping by global row) yields byte-identical batches to the
+    eager base-class path, across multiple files with odd sizes."""
+    x = fluid.Program()
+    with fluid.program_guard(x, fluid.Program()):
+        ids = fluid.data("ids", [3], "int64")
+        label = fluid.data("label", [1], "int64")
+
+    rng = np.random.RandomState(0)
+    paths = []
+    row = 0
+    for fi, n in enumerate([5, 3, 7]):   # odd sizes force remainder carry
+        p = tmp_path / f"part-{fi}.txt"
+        with open(p, "w") as f:
+            for _ in range(n):
+                f.write(f"{row} {row+1} {row+2};{row % 2}\n")
+                row += 1
+        paths.append(str(p))
+
+    def batches(cls, stripe=None, drop_last=False):
+        ds = fluid.DatasetFactory().create_dataset(cls)
+        ds.set_batch_size(4)
+        ds.set_use_var([ids, label])
+        ds.set_filelist(paths)
+        ds.drop_last = drop_last
+        if stripe:
+            ds._stripe = stripe
+        if cls == "InMemoryDataset":
+            ds.load_into_memory()
+        return list(ds._iter_batches())
+
+    for stripe in (None, (0, 2), (1, 2)):
+        for drop_last in (False, True):
+            q = batches("QueueDataset", stripe, drop_last)
+            m = batches("InMemoryDataset", stripe, drop_last)
+            assert len(q) == len(m), (stripe, drop_last, len(q), len(m))
+            for bq, bm in zip(q, m):
+                np.testing.assert_array_equal(bq["ids"], bm["ids"])
+                np.testing.assert_array_equal(bq["label"], bm["label"])
