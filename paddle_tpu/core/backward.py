@@ -29,31 +29,60 @@ def _find_contributing_ops(block: Block, wanted: Set[str]) -> Set[int]:
 
 
 class _GradState:
-    """Tracks per-var gradient contributions and merges them on demand."""
+    """Tracks per-var gradient contributions and merges them on demand.
+
+    Naming must be collision-free ACROSS backward passes: a second
+    ``gradients()`` / ``append_backward`` call over a program that already
+    holds grad vars (double gradients, gradient-penalty losses) must not
+    overwrite the earlier pass's vars -- canonical names are only claimed
+    when still free, otherwise a fresh @RENAME@ name (checked against the
+    block, not just this pass's contribution count) is used.
+    """
 
     def __init__(self, block: Block):
         self.block = block
         self.contribs: Dict[str, List[str]] = {}
+        self._settled: Dict[str, str] = {}
+        self._uniq = 0
 
     def seed(self, name: str, grad_name: str):
         self.contribs[name] = [grad_name]
+        self._settled[name] = grad_name
+
+    def _fresh(self, base: str) -> str:
+        while True:
+            cand = f"{base}@RENAME@{self._uniq}"
+            self._uniq += 1
+            if not self.block.has_var(cand):
+                return cand
 
     def settle(self, name: str) -> Optional[str]:
-        """Merge contributions for ``name`` into its canonical grad var; None if no
-        gradient flows to it."""
+        """Merge contributions for ``name`` into one grad var (the canonical
+        ``name@GRAD`` when free); None if no gradient flows to it.
+
+        Idempotent ONLY while no new contribution arrived since the last
+        settle: a seeded target that also receives flow from another target
+        (gradients([y, z], ...) with z downstream of y) re-merges."""
         c = self.contribs.get(name)
         if not c:
             return None
+        settled = self._settled.get(name)
+        if settled is not None and c == [settled]:
+            return settled
         canonical = grad_var_name(name)
-        if len(c) == 1:
-            if c[0] != canonical:
-                self.block.append_op("assign", inputs={"X": [c[0]]},
-                                     outputs={"Out": [canonical]})
-                self.contribs[name] = [canonical]
+        if len(c) == 1 and c[0] == canonical:
+            self._settled[name] = canonical
             return canonical
-        self.block.append_op("sum", inputs={"X": list(c)},
-                             outputs={"Out": [canonical]})
+        if self.block.has_var(canonical) and canonical not in c:
+            canonical = self._fresh(canonical)
+        if len(c) == 1:
+            self.block.append_op("assign", inputs={"X": [c[0]]},
+                                 outputs={"Out": [canonical]})
+        else:
+            self.block.append_op("sum", inputs={"X": list(c)},
+                                 outputs={"Out": [canonical]})
         self.contribs[name] = [canonical]
+        self._settled[name] = canonical
         return canonical
 
     def add(self, name: str) -> str:
@@ -62,7 +91,7 @@ class _GradState:
         existing = self.contribs.setdefault(name, [])
         gname = grad_var_name(name)
         if existing or self.block.has_var(gname):
-            gname = f"{gname}@RENAME@{len(existing)}"
+            gname = self._fresh(gname)
         existing.append(gname)
         return gname
 
@@ -192,6 +221,10 @@ def gradients(targets, inputs, target_gradients=None,
     out = []
     for iv in inputs:
         g = state.settle(iv.name)
+        if g:
+            # returned grads are differentiable functions of the program
+            # inputs: double-grad / gradient-penalty losses build on them
+            block.vars[g].stop_gradient = False
         out.append(block.vars[g] if g else None)
     return out
 

@@ -141,8 +141,10 @@ def get(type: str) -> OpDef:
     d = _REGISTRY.get(type)
     if d is not None:
         return d
-    if type.endswith("_grad") and type[:-5] in _REGISTRY:
-        return _grad_opdef(type[:-5])
+    if type.endswith("_grad"):
+        base = type[:-5]
+        if base in _REGISTRY or base.endswith("_grad"):
+            return _grad_opdef(base)
     raise KeyError(
         f"op type {type!r} is not registered in paddle_tpu "
         f"({len(_REGISTRY)} ops registered). If this is a reference op not yet "
@@ -167,14 +169,25 @@ def is_registered(type: str) -> bool:
 
 @functools.lru_cache(maxsize=None)
 def _grad_opdef(fwd_type: str) -> OpDef:
-    fwd = _REGISTRY[fwd_type]
+    fwd = _REGISTRY.get(fwd_type)
+    if fwd is None:
+        if fwd_type.endswith("_grad"):   # higher-order: tanh_grad_grad etc.
+            fwd = _grad_opdef(fwd_type[:-5])
+        else:
+            raise KeyError(f"op type {fwd_type!r} is not registered")
     if fwd.grad is None:
         raise KeyError(f"op {fwd_type!r} is non-differentiable; no {fwd_type}_grad")
 
     def lower(ctx, ins):
         return _generic_grad_lower(fwd, ctx, ins)
 
-    return OpDef(fwd_type + "_grad", lower, infer_shape=_grad_infer_shape, grad=None)
+    # grad ops are themselves differentiable through the same vjp machinery
+    # (jax.vjp of a jax.vjp), which is what Program-level double gradients --
+    # reference gradient_checker.py double_grad_check / gradient-penalty
+    # training -- lower to. Recursion via the _REGISTRY.get fallback above
+    # supports any order.
+    return OpDef(fwd_type + "_grad", lower, infer_shape=_grad_infer_shape,
+                 grad="auto")
 
 
 def _is_float(x) -> bool:
@@ -196,9 +209,16 @@ def _generic_grad_lower(fwd: OpDef, ctx, ins):
     import jax.numpy as jnp
 
     fwd_out_slots = set(ctx.attr("__fwd_out_slots__", []))
+    # cotangent slots are exactly <fwd out slot>+"@GRAD". When fwd is itself
+    # a grad op its INPUT slots also end in "@GRAD" ("Out@GRAD"), so "ends
+    # with @GRAD" alone cannot distinguish them -- match against
+    # fwd_out_slots instead (second-order support).
+    def _is_cot(s):
+        return s.endswith("@GRAD") and s[:-5] in fwd_out_slots
+
     fwd_in_slots = sorted(s for s in ins
-                          if not s.endswith("@GRAD") and s not in fwd_out_slots)
-    grad_by_slot = {s[:-5]: ins[s] for s in ins if s.endswith("@GRAD")}
+                          if s not in fwd_out_slots and not _is_cot(s))
+    grad_by_slot = {s[:-5]: ins[s] for s in ins if _is_cot(s)}
 
     diff_keys, primals = [], []
     for s in fwd_in_slots:
@@ -209,7 +229,14 @@ def _generic_grad_lower(fwd: OpDef, ctx, ins):
                 diff_keys.append((s, i))
                 primals.append(v)
 
-    fwd_attrs = {k: v for k, v in ctx.attrs.items() if not k.startswith("__fwd_")}
+    # the fwd op's own attrs: the nested snapshot when fwd is itself a grad
+    # op (its __fwd_* bookkeeping must survive -- the desc maker overwrote
+    # the flat keys with this level's), else the flat attrs minus this
+    # level's bookkeeping
+    fwd_attrs = ctx.attr("__fwd_attrs__", None)
+    if fwd_attrs is None:
+        fwd_attrs = {k: v for k, v in ctx.attrs.items()
+                     if not k.startswith("__fwd_")}
     fwd_ctx = LowerCtx(fwd_attrs, ctx._base_key, ctx._salt, ctx.block_runner,
                        ctx.program, ctx.mesh, gspmd_mesh=ctx.gspmd_mesh)
 
@@ -283,6 +310,10 @@ def make_grad_op_descs(op: Operator, grad_out_map: Dict[str, str]) -> List[dict]
             continue
         outputs[s + "@GRAD"] = [grad_var_name(n) for n in names]
     attrs = dict(op.attrs)
+    # snapshot the op's own attrs BEFORE overwriting the __fwd_* keys with
+    # this level's bookkeeping: when ``op`` is itself a grad op, its lowering
+    # needs its own __fwd_out_slots__/__fwd_attrs__ back (second order)
+    attrs["__fwd_attrs__"] = dict(op.attrs)
     attrs["__fwd_out_slots__"] = sorted(op.outputs)
     first_out = next((ns[0] for ns in op.outputs.values() if ns), "")
     attrs["__fwd_out0__"] = first_out
@@ -306,7 +337,14 @@ def infer_shape(op: Operator, block: Block):
 
 
 def _grad_infer_shape(op: Operator, block: Block):
-    """Grad var shapes mirror the corresponding forward input var shapes."""
+    """Grad var shapes mirror the corresponding forward input var shapes.
+
+    Grad vars are differentiable (stop_gradient=False): they are functions
+    of the forward inputs, and a later backward pass -- double gradients,
+    gradient-penalty losses -- must be able to differentiate through them
+    (reference gradient_checker.py double_grad_check). append_backward still
+    marks the settled PARAM grads it hands to optimizers as stop_gradient.
+    """
     for slot, names in op.outputs.items():
         if not slot.endswith("@GRAD"):
             continue
@@ -318,9 +356,9 @@ def _grad_infer_shape(op: Operator, block: Block):
                 sv = block.find_var_recursive(src[i])
                 if sv is not None:
                     v = block.create_var(n, sv.shape, sv.dtype)
-                    v.stop_gradient = True
+                    v.stop_gradient = False
                     continue
-            block.create_var(n, (), "float32").stop_gradient = True
+            block.create_var(n, (), "float32").stop_gradient = False
 
 
 def _eval_shape_infer(d: OpDef, op: Operator, block: Block):

@@ -165,3 +165,113 @@ class OpTest(unittest.TestCase):
                 msg=f"{self.op_type}: grad wrt {name}: relative diff {diff} "
                     f"(analytic {got.reshape(-1)[:5]} vs numeric "
                     f"{num.reshape(-1)[:5]})")
+
+    def check_double_grad(self, inputs_to_check, output_name,
+                          max_relative_error=0.01,
+                          numeric_grad_delta=1e-3, seed=0):
+        """Second-order check (reference gradient_checker.py:1
+        double_grad_check): with obj2(x) = sum(d mean(out)/dx * v) for a
+        fixed random vector v, compare the analytic d obj2/dx -- built by a
+        SECOND fluid.gradients() pass over the first pass's grad ops --
+        against central finite differences of obj2."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_io, feed = {}, {}
+            for slot, val in self.inputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for nm, arr in entries:
+                    arr = np.asarray(arr)
+                    v = block.create_var(nm, arr.shape, str(arr.dtype),
+                                         is_data=True)
+                    v.stop_gradient = False
+                    names.append(nm)
+                    feed[nm] = arr
+                in_io[slot] = names
+            out_io = {}
+            for slot, val in self.outputs.items():
+                if isinstance(val, list):
+                    out_io[slot] = [nm for nm, _ in val]
+                else:
+                    out_io[slot] = [slot + "@OUT"]
+            block.append_op(self.op_type, inputs=in_io, outputs=out_io,
+                            attrs=self.attrs)
+            out_var_name = (output_name + "@OUT"
+                            if output_name in self.outputs and
+                            not isinstance(self.outputs[output_name], list)
+                            else output_name)
+            mean_out = block.create_var("mean@OUT", (1,), "float32")
+            block.append_op("mean", inputs={"X": [block.var(out_var_name)]},
+                            outputs={"Out": [mean_out]})
+
+            xs = [main.global_block().var(n) for n in inputs_to_check]
+            first = fluid.gradients([main.global_block().var("mean@OUT")], xs)
+            rng = np.random.RandomState(seed)
+            obj_terms = []
+            vvecs = {}
+            for n, g in zip(inputs_to_check, first):
+                assert g is not None, f"no first-order grad for {n}"
+                vv = rng.randn(*np.asarray(feed[n]).shape).astype("float32")
+                vvecs[n] = vv
+                vvar = block.create_var(f"v_{n}", vv.shape, "float32",
+                                        is_data=True)
+                vvar.stop_gradient = True
+                feed[f"v_{n}"] = vv
+                prod = block.create_var(f"gv_{n}", vv.shape, "float32")
+                block.append_op("elementwise_mul",
+                                inputs={"X": [g.name], "Y": [f"v_{n}"]},
+                                outputs={"Out": [prod.name]})
+                t = block.create_var(f"obj_{n}", (1,), "float32")
+                block.append_op("reduce_sum", inputs={"X": [prod.name]},
+                                outputs={"Out": [t.name]},
+                                attrs={"dim": None, "keep_dim": False,
+                                       "reduce_all": True})
+                obj_terms.append(t.name)
+            if len(obj_terms) == 1:
+                obj_name = obj_terms[0]
+            else:
+                obj = block.create_var("obj2@OUT", (1,), "float32")
+                block.append_op("sum", inputs={"X": obj_terms},
+                                outputs={"Out": [obj.name]})
+                obj_name = obj.name
+            second = fluid.gradients([block.var(obj_name)], xs)
+
+        for n, g in zip(inputs_to_check, second):
+            assert g is not None, f"no double grad flows to {n}"
+        exe = fluid.Executor()
+        fetch = [obj_name] + [g.name for g in second]
+        with fluid.scope_guard(fluid.Scope()):
+            results = exe.run(main, feed=feed, fetch_list=fetch)
+        analytic = results[1:]
+
+        def f_obj(feed_override):
+            with fluid.scope_guard(fluid.Scope()):
+                r = exe.run(main, feed=feed_override, fetch_list=[obj_name])
+            return float(np.asarray(r[0]).reshape(-1)[0])
+
+        for name, got in zip(inputs_to_check, analytic):
+            assert got is not None, f"no double grad for {name}"
+            base = np.asarray(feed[name], dtype=np.float64)
+            num = np.zeros(base.size)
+            flat = base.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + numeric_grad_delta
+                fp = f_obj({**feed, name: base.reshape(feed[name].shape)
+                            .astype(feed[name].dtype)})
+                flat[i] = orig - numeric_grad_delta
+                fm = f_obj({**feed, name: base.reshape(feed[name].shape)
+                            .astype(feed[name].dtype)})
+                flat[i] = orig
+                num[i] = (fp - fm) / (2 * numeric_grad_delta)
+            num = num.reshape(base.shape)
+            got = np.asarray(got, dtype=np.float64)
+            abs_max = max(np.abs(num).max(), np.abs(got).max(), 1e-3)
+            diff = np.abs(num - got).max() / abs_max
+            self.assertLessEqual(
+                diff, max_relative_error,
+                msg=f"{self.op_type}: DOUBLE grad wrt {name}: relative diff "
+                    f"{diff} (analytic {got.reshape(-1)[:5]} vs numeric "
+                    f"{num.reshape(-1)[:5]})")

@@ -109,3 +109,60 @@ def test_bf16_weights_quantize_and_shared_consumer_safe():
     # int8 rounding only -- a raw-int8 read would be off by orders of magnitude
     assert np.abs(got - ref).max() < 0.05 * max(np.abs(ref).max(), 1.0), (
         got, ref)
+
+
+def test_ptq_accuracy_within_one_point_of_fp32():
+    """VERDICT r4 #6: the SCOPE quantization row claims weight-only PTQ (on
+    top of bf16-AMP training) makes QAT unnecessary on TPU -- demonstrated
+    here, not asserted: train the CIFAR convnet, PTQ-quantize the inference
+    program, and the quantized accuracy must stay within 1 point of fp32.
+    (If this ever fails, implement the fake-quant QAT rewrite -- reference
+    slim/quantization/quantization_pass.py:116.)"""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3072], "float32")
+        label = fluid.data("label", [1], "int64")
+        x = fluid.layers.reshape(img, [-1, 3, 32, 32])
+        h = fluid.layers.conv2d(x, 16, 3, padding=1, act="relu")
+        h = fluid.layers.pool2d(h, 2, "max", 2)
+        h = fluid.layers.conv2d(h, 32, 3, padding=1, act="relu")
+        h = fluid.layers.pool2d(h, 2, "max", 2)
+        h = fluid.layers.fc(h, 64, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(0.002).minimize(loss)
+
+    train = list(fluid.dataset.cifar.train10()())
+    test = list(fluid.dataset.cifar.test10()())[:512]
+    tx = np.stack([s[0] for s in test]).astype(np.float32)
+    ty = np.array([[s[1]] for s in test], "int64")
+    rng = np.random.RandomState(0)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        n = len(train)
+        for step in range(250):
+            take = rng.randint(0, n, 64)
+            bx = np.stack([train[i][0] for i in take]).astype(np.float32)
+            by = np.array([[train[i][1]] for i in take], "int64")
+            exe.run(main, feed={"img": bx, "label": by}, fetch_list=[])
+        a32, = exe.run(test_prog, feed={"img": tx, "label": ty},
+                       fetch_list=[acc])
+        a32 = float(np.asarray(a32).reshape(()))
+        from paddle_tpu.contrib import quantize as QZ
+        qmap = QZ.quantize_weights(test_prog, scope)
+        assert qmap, "nothing was quantized"
+        a8, = exe.run(test_prog, feed={"img": tx, "label": ty},
+                      fetch_list=[acc])
+        a8 = float(np.asarray(a8).reshape(()))
+    assert a32 > 0.5, f"fp32 convnet failed to learn (acc={a32})"
+    assert abs(a32 - a8) < 0.01, (
+        f"PTQ accuracy {a8} drifted >1pt from fp32 {a32}: the SCOPE "
+        f"quantization claim no longer holds -- implement QAT")
