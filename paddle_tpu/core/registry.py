@@ -184,8 +184,10 @@ def _grad_opdef(fwd_type: str) -> OpDef:
     # grad ops are themselves differentiable through the same vjp machinery
     # (jax.vjp of a jax.vjp), which is what Program-level double gradients --
     # reference gradient_checker.py double_grad_check / gradient-penalty
-    # training -- lower to. Recursion via the _REGISTRY.get fallback above
-    # supports any order.
+    # training -- lower to. SECOND order only: a *_grad_grad op reuses slot
+    # names as both inputs and outputs, which the desc maker rejects with a
+    # clear error rather than silently clobbering (third order would need
+    # per-level slot namespacing).
     return OpDef(fwd_type + "_grad", lower, infer_shape=_grad_infer_shape,
                  grad="auto")
 
@@ -297,6 +299,15 @@ def make_grad_op_descs(op: Operator, grad_out_map: Dict[str, str]) -> List[dict]
         return []
     if callable(fwd.grad):
         return fwd.grad(op, grad_out_map)
+
+    clash = set(op.inputs) & set(op.outputs)
+    if clash:
+        # *_grad_grad ops reuse slot names on both sides; building their
+        # grad descs would clobber the primal inputs (slots {clash}) --
+        # second-order is the supported ceiling
+        raise NotImplementedError(
+            f"gradients of {op.type!r}: third-order gradients are not "
+            f"supported (input/output slot collision on {sorted(clash)})")
 
     inputs: Dict[str, List[str]] = {s: list(n) for s, n in op.inputs.items()}
     for s, names in op.outputs.items():

@@ -19,12 +19,12 @@ SURVEY.md §5.4). bfloat16 is stored as uint16 with a sidecar dtype tag.
 from __future__ import annotations
 
 import json
-import os
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .core.executor import Executor, Scope, global_scope
+from .utils import fs as _fsio
 from .framework import Parameter, Program, Variable, default_main_program
 
 
@@ -93,7 +93,7 @@ def _save_var(dirname, name, val, rank):
             seen.add(key)
             arr, dtype = _storage_view(np.asarray(sh.data))
             fname = f"{base}.r{rank}c{i}.npy"
-            np.save(os.path.join(dirname, fname), arr, allow_pickle=False)
+            _fsio.save_array(_fsio.join(dirname, fname), arr)
             chunks.append({"file": fname, "index": region})
         if not chunks:
             return None
@@ -107,7 +107,7 @@ def _save_var(dirname, name, val, rank):
         return None
     arr, dtype = _storage_view(np.asarray(val))
     fname = base + ".npy"
-    np.save(os.path.join(dirname, fname), arr, allow_pickle=False)
+    _fsio.save_array(_fsio.join(dirname, fname), arr)
     return {"name": name, "dtype": dtype, "shape": list(arr.shape),
             "chunks": [{"file": fname,
                         "index": [[0, s] for s in arr.shape]}]}
@@ -124,7 +124,7 @@ def _stitch(dirname, meta, region):
                  for (a, b), (ca, cb) in zip(region, cidx)]
         if any(lo >= hi for lo, hi in inter):
             continue
-        src = np.load(os.path.join(dirname, ch["file"]), mmap_mode="r")
+        src = _fsio.load_array(_fsio.join(dirname, ch["file"]))
         src_sl = tuple(slice(lo - ca, hi - ca)
                        for (lo, hi), (ca, _) in zip(inter, cidx))
         dst_sl = tuple(slice(lo - a, hi - a)
@@ -171,14 +171,14 @@ def _unwrap_program(main_program):
 
 def _manifest_path(dirname, filename, rank):
     base = filename or "__manifest__.json"
-    return os.path.join(dirname, base if rank == 0 else f"{base}.rank{rank}")
+    return _fsio.join(dirname, base if rank == 0 else f"{base}.rank{rank}")
 
 
 def _read_manifests(dirname, filename):
-    base = os.path.join(dirname, filename or "__manifest__.json")
-    if not os.path.exists(base):
+    base = _fsio.join(dirname, filename or "__manifest__.json")
+    if not _fsio.exists(base):
         raise FileNotFoundError(f"no checkpoint manifest at {base}")
-    with open(base) as f:
+    with _fsio.open_file(base) as f:
         head = json.load(f)
     # nranks recorded at save time bounds which rank manifests belong to THIS
     # checkpoint -- a stale .rankN from an earlier wider save in the same dir
@@ -187,11 +187,11 @@ def _read_manifests(dirname, filename):
     metas = {}
     for r in range(nranks):
         p = base if r == 0 else f"{base}.rank{r}"
-        if not os.path.exists(p):
+        if not _fsio.exists(p):
             raise FileNotFoundError(
                 f"checkpoint at {dirname} was saved by {nranks} processes but "
                 f"rank {r}'s manifest {p} is missing")
-        with open(p) as f:
+        with _fsio.open_file(p) as f:
             doc = head if r == 0 else json.load(f)
         for m in doc["vars"]:
             if m["name"] in metas:
@@ -213,7 +213,7 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[List] = None,
         vars = [v for v in main_program.list_vars()
                 if (predicate is None or predicate(v))]
     rank = jax.process_index()
-    os.makedirs(dirname, exist_ok=True)
+    _fsio.makedirs(dirname, exist_ok=True)
     _barrier()   # every process must see the directory before writing
     manifest = []
     for v in vars:
@@ -225,7 +225,7 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[List] = None,
         entry = _save_var(dirname, name, val, rank)
         if entry is not None:
             manifest.append(entry)
-    with open(_manifest_path(dirname, filename, rank), "w") as f:
+    with _fsio.open_file(_manifest_path(dirname, filename, rank), "w") as f:
         json.dump({"vars": manifest, "nranks": jax.process_count()}, f)
     _barrier()   # checkpoint is complete only when every rank has written
 
@@ -314,11 +314,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     target_names = [t.name if isinstance(t, Variable) else str(t)
                     for t in target_vars]
     pruned = _prune(main_program, feeded_var_names, target_names)
-    os.makedirs(dirname, exist_ok=True)
+    _fsio.makedirs(dirname, exist_ok=True)
     model = {"program": pruned.to_dict(), "feed_names": list(feeded_var_names),
              "fetch_names": target_names}
-    with open(os.path.join(dirname, model_filename or "__model__.json"),
-              "w") as f:
+    with _fsio.open_file(_fsio.join(dirname, model_filename or
+                                    "__model__.json"), "w") as f:
         json.dump(model, f)
     params = [v for v in pruned.list_vars() if isinstance(
         main_program.global_block().vars.get(v.name), Parameter) or
@@ -331,7 +331,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     """Reference io.py:1201. Returns (program, feed_names, fetch_names)."""
-    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+    with _fsio.open_file(_fsio.join(dirname, model_filename or
+                                    "__model__.json")) as f:
         model = json.load(f)
     program = Program.from_dict(model["program"])
     scope = global_scope()

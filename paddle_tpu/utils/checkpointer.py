@@ -11,9 +11,10 @@ written last so a crash mid-save never corrupts the resume point.
 from __future__ import annotations
 
 import json
-import os
-import shutil
+
 import time
+
+from . import fs as _fsio
 from typing import Optional
 
 
@@ -47,7 +48,7 @@ class Checkpointer:
         self._last_save_step: Optional[int] = None
 
     def _step_dir(self, step: int) -> str:
-        return os.path.join(self.dirname, f"ckpt-{step}")
+        return _fsio.join(self.dirname, f"ckpt-{step}")
 
     def _is_rank0(self) -> bool:
         import jax
@@ -59,15 +60,16 @@ class Checkpointer:
         d = self._step_dir(step)
         io.save_persistables(self.exe, d, self.program)   # barriers inside
         if self._is_rank0():
-            with open(os.path.join(self.dirname, "LATEST.tmp"), "w") as f:
+            with _fsio.open_file(_fsio.join(self.dirname, "LATEST.tmp"),
+                                 "w") as f:
                 json.dump({"step": step, "time": time.time()}, f)
-            os.replace(os.path.join(self.dirname, "LATEST.tmp"),
-                       os.path.join(self.dirname, "LATEST"))
+            _fsio.replace(_fsio.join(self.dirname, "LATEST.tmp"),
+                          _fsio.join(self.dirname, "LATEST"))
             kept = sorted((int(n.split("-", 1)[1])
-                           for n in os.listdir(self.dirname)
+                           for n in _fsio.listdir(self.dirname)
                            if n.startswith("ckpt-")), reverse=True)
             for old in kept[self.max_to_keep:]:
-                shutil.rmtree(self._step_dir(old), ignore_errors=True)
+                _fsio.rmtree(self._step_dir(old), ignore_errors=True)
         barrier("checkpointer_save")
         self._last_save_t = time.time()
         self._last_save_step = step
@@ -82,10 +84,10 @@ class Checkpointer:
             self.save(step)
 
     def latest_step(self) -> int:
-        path = os.path.join(self.dirname, "LATEST")
-        if not os.path.exists(path):
+        path = _fsio.join(self.dirname, "LATEST")
+        if not _fsio.exists(path):
             return -1
-        with open(path) as f:
+        with _fsio.open_file(path) as f:
             return int(json.load(f)["step"])
 
     def restore(self, program=None) -> int:

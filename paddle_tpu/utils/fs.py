@@ -1,0 +1,114 @@
+"""Filesystem hook for checkpoint/model IO (reference framework/io/fs.cc,
+shell.cc: the hdfs/local FS helpers behind save/load -- VERDICT r4 #9).
+
+Local paths use the standard library; any path with a URL scheme
+("hdfs://...", "gs://...", "s3://...") dispatches through fsspec, which is
+how multi-host TPU jobs point Checkpointer/save_inference_model at shared
+storage without code changes. The reference's shell-command fallback
+(shell.cc piping `hadoop fs` subprocesses) is deliberately not reproduced:
+fsspec covers the same protocols with real Python file objects.
+
+Every helper accepts both plain paths and scheme'd URLs, so io.py and
+Checkpointer call these unconditionally.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import IO, List
+
+
+def is_remote(path) -> bool:
+    return "://" in str(path)
+
+
+def _fs(path):
+    import fsspec
+    fs, _ = fsspec.core.url_to_fs(str(path))
+    return fs
+
+
+def join(*parts) -> str:
+    if is_remote(parts[0]):
+        base = str(parts[0]).rstrip("/")
+        return "/".join([base] + [str(p).strip("/") for p in parts[1:]])
+    return os.path.join(*parts)
+
+
+def open_file(path, mode: str = "r") -> IO:
+    if is_remote(path):
+        import fsspec
+        return fsspec.open(str(path), mode).open()
+    return open(path, mode)
+
+
+def exists(path) -> bool:
+    if is_remote(path):
+        return _fs(path).exists(str(path))
+    return os.path.exists(path)
+
+
+def makedirs(path, exist_ok: bool = True):
+    if is_remote(path):
+        _fs(path).makedirs(str(path), exist_ok=exist_ok)
+        return
+    os.makedirs(path, exist_ok=exist_ok)
+
+
+def listdir(path) -> List[str]:
+    if is_remote(path):
+        return [p.rstrip("/").rsplit("/", 1)[-1]
+                for p in _fs(path).ls(str(path), detail=False)]
+    return os.listdir(path)
+
+
+def rmtree(path, ignore_errors: bool = True):
+    if is_remote(path):
+        try:
+            _fs(path).rm(str(path), recursive=True)
+        except Exception:
+            if not ignore_errors:
+                raise
+        return
+    shutil.rmtree(path, ignore_errors=ignore_errors)
+
+
+def replace(src, dst):
+    """Atomic-on-local rename; copy-then-delete on remote stores (object
+    stores have no rename -- callers tolerate the non-atomic window there,
+    as the reference's hdfs mv does)."""
+    if is_remote(src) or is_remote(dst):
+        fs = _fs(dst)
+        try:
+            fs.mv(str(src), str(dst))
+        except Exception:
+            fs.copy(str(src), str(dst))
+            fs.rm(str(src))
+        return
+    os.replace(src, dst)
+
+
+def save_array(path, arr):
+    """np.save through the hook (np.save writes to file objects)."""
+    import numpy as np
+    if is_remote(path):
+        p = str(path)
+        if not p.endswith(".npy"):
+            p += ".npy"
+        with open_file(p, "wb") as f:
+            np.save(f, arr, allow_pickle=False)
+        return
+    np.save(path, arr, allow_pickle=False)
+
+
+def load_array(path, mmap: bool = True):
+    """np.load; local paths may memory-map, remote streams the bytes."""
+    import numpy as np
+    if is_remote(path):
+        p = str(path)
+        if not p.endswith(".npy"):
+            p += ".npy"
+        with open_file(p, "rb") as f:
+            return np.load(f, allow_pickle=False)
+    return np.load(path, mmap_mode="r" if mmap else None,
+                   allow_pickle=False)
