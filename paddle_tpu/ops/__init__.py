@@ -23,6 +23,7 @@ from . import detection_ops  # noqa: F401
 from . import beam_ops       # noqa: F401
 from . import pallas_attention  # noqa: F401
 from . import pallas_conv_bn  # noqa: F401
+from . import tail_ops  # noqa: F401
 from . import extra_ops      # noqa: F401
 from . import ctc_crf_ops    # noqa: F401
 from . import sampled_ops    # noqa: F401

@@ -1,0 +1,341 @@
+"""Operator-library tail (round 5): the remaining user-facing math/NN ops
+from the reference's registry that are neither scoped infrastructure
+(PS/RPC/LoD/engine/fake-quant rows in SCOPE.md) nor niche kernels.
+
+Each op cites its reference implementation. All are jnp/lax lowerings --
+fixed shapes, differentiable through the registry's auto-vjp unless marked
+grad=None.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register, simple_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+# -- activations / losses ----------------------------------------------------
+
+@simple_op("selu")
+def selu(ctx, x):
+    """Reference selu_op.cc: scale * (x > 0 ? x : alpha * (exp(x) - 1))."""
+    jnp = _jnp()
+    scale = ctx.attr("scale", 1.0507009873554805)
+    alpha = ctx.attr("alpha", 1.6732632423543772)
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@register("hinge_loss")
+def hinge_loss(ctx, ins):
+    """Reference hinge_loss_op.cc: max(1 - pred * (2*label - 1), 0)."""
+    jnp = _jnp()
+    pred, label = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(
+        1.0 - pred * (2.0 * label.astype(pred.dtype) - 1.0), 0.0)]}
+
+
+@register("modified_huber_loss")
+def modified_huber_loss(ctx, ins):
+    """Reference modified_huber_loss_op.cc over z = pred * (2y - 1):
+    z >= -1 -> max(0, 1-z)^2 ; z < -1 -> -4z. IntermediateVal carries z
+    (the reference saves it for backward; auto-vjp recomputes, the output
+    exists for parity)."""
+    jnp = _jnp()
+    pred, label = ins["X"][0], ins["Y"][0]
+    z = pred * (2.0 * label.astype(pred.dtype) - 1.0)
+    loss = jnp.where(z >= -1.0, jnp.square(jnp.maximum(1.0 - z, 0.0)),
+                     -4.0 * z)
+    import jax
+    return {"Out": [loss], "IntermediateVal": [jax.lax.stop_gradient(z)]}
+
+
+@register("squared_l2_distance")
+def squared_l2_distance(ctx, ins):
+    """Reference squared_l2_distance_op.cc: per-row sum of squared
+    differences; sub_result is saved for backward (parity output)."""
+    import jax
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y   # y may be [1, K]: broadcast like the reference
+    return {"Out": [jnp.sum(jnp.square(sub), axis=-1, keepdims=True)],
+            "sub_result": [jax.lax.stop_gradient(sub)]}
+
+
+@simple_op("l1_norm")
+def l1_norm(ctx, x):
+    """Reference l1_norm_op.cc: sum of absolute values (scalar [1])."""
+    jnp = _jnp()
+    return jnp.sum(jnp.abs(x)).reshape(1)
+
+
+# -- elementwise / tensor utilities ------------------------------------------
+
+@register("minus")
+def minus(ctx, ins):
+    """Reference minus_op.cc: Out = X - Y."""
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register("norm")
+def norm(ctx, ins):
+    """Reference norm_op.cc: l2-normalize along ``axis``; Norm holds
+    sqrt(sum(x^2) + eps) (saved for backward in the reference)."""
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", 1)
+    eps = ctx.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n], "Norm": [jax.lax.stop_gradient(n)]}
+
+
+@register("size", grad=None)
+def size(ctx, ins):
+    """Reference size_op.cc: number of elements. The reference emits int64;
+    this framework runs with x64 disabled so integer outputs are int32
+    (the repo-wide int convention -- fine below 2^31 elements)."""
+    jnp = _jnp()
+    return {"Out": [jnp.asarray([int(np.prod(ins["Input"][0].shape))],
+                                jnp.int32)]}
+
+
+@register("fill", grad=None)
+def fill(ctx, ins):
+    """Reference fill_op.cc: materialize attr ``value`` (flat float list)
+    as a tensor of attr shape/dtype."""
+    jnp = _jnp()
+    from ..framework import convert_dtype
+    shape = ctx.attr("shape", [])
+    dtype = convert_dtype(ctx.attr("dtype", 5))
+    vals = np.asarray(ctx.attr("value", []), dtype="float64")
+    return {"Out": [jnp.asarray(vals.reshape(shape), dtype=dtype)]}
+
+
+@register("fill_zeros_like2", grad=None)
+def fill_zeros_like2(ctx, ins):
+    """Reference fill_zeros_like_op.cc (v2: explicit dtype attr)."""
+    jnp = _jnp()
+    from ..framework import convert_dtype
+    dt = ctx.attr("dtype", None)
+    x = ins["X"][0]
+    return {"Out": [jnp.zeros(x.shape,
+                              convert_dtype(dt) if dt is not None
+                              else x.dtype)]}
+
+
+@register("crop")
+def crop(ctx, ins):
+    """Reference crop_op.cc: static-offset crop to ``shape`` (or Y's
+    shape). The runtime-Offsets input variant is served by crop_tensor."""
+    lax = _lax()
+    x = ins["X"][0]
+    y = ins.get("Y", [None])[0]
+    shape = list(y.shape) if y is not None else list(ctx.attr("shape", []))
+    offsets = list(ctx.attr("offsets", []) or [0] * x.ndim)
+    return {"Out": [lax.slice(x, offsets,
+                              [o + s for o, s in zip(offsets, shape)])]}
+
+
+@register("fc")
+def fc(ctx, ins):
+    """Reference operators/fc_op.cc (the fused inference op; the Python
+    layers.fc builds mul+add instead): flatten to in_num_col_dims, matmul,
+    optional bias."""
+    jnp = _jnp()
+    x, w = ins["Input"][0], ins["W"][0]
+    ncol = ctx.attr("in_num_col_dims", 1)
+    x2 = x.reshape((int(np.prod(x.shape[:ncol])), -1))
+    out = jnp.dot(x2, w)
+    b = ins.get("Bias", [None])[0]
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    return {"Out": [out.reshape(tuple(x.shape[:ncol]) + (w.shape[1],))]}
+
+
+@register("cvm")
+def cvm(ctx, ins):
+    """Reference cvm_op.cc: X rows are [show, click, features...];
+    use_cvm=True keeps width D with Y[0]=log(show+1),
+    Y[1]=log(click+1)-log(show+1); False drops the two CVM columns."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    if ctx.attr("use_cvm", True):
+        show = jnp.log(x[:, :1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - show
+        return {"Y": [jnp.concatenate([show, click, x[:, 2:]], axis=1)]}
+    return {"Y": [x[:, 2:]]}
+
+
+@register("conv_shift")
+def conv_shift(ctx, ins):
+    """Reference conv_shift_op.cc (circular convolution, NTM-style):
+    out[b, i] = sum_j x[b, (i + j - (M-1)//2) mod N] * y[b, j]."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    m = y.shape[1]
+    half = (m - 1) // 2
+    out = 0.0
+    for j in range(m):   # M is small (the shift kernel), static unroll
+        out = out + jnp.roll(x, -(j - half), axis=1) * y[:, j:j + 1]
+    return {"Out": [out]}
+
+
+# -- pooling tail ------------------------------------------------------------
+
+@register("max_pool2d_with_index", nondiff_outputs=("Mask",))
+def max_pool2d_with_index(ctx, ins):
+    """Reference pool_with_index_op.cc: max pool + flat argmax indices into
+    each input feature map (consumed by unpool). Non-overlapping windows
+    (stride == ksize, the unpool use case); overlapping windows raise."""
+    jnp = _jnp()
+    import jax
+    x = ins["X"][0]
+    k = ctx.attr("ksize", [2, 2])
+    s = ctx.attr("strides", k) or k
+    p = ctx.attr("paddings", [0, 0]) or [0, 0]
+    if list(k) != list(s) or any(p):
+        raise NotImplementedError(
+            "max_pool2d_with_index: non-overlapping unpadded windows only "
+            "(stride == ksize); use pool2d for plain max pooling")
+    n, c, h, w = x.shape
+    kh, kw = int(k[0]), int(k[1])
+    xb = x.reshape(n, c, h // kh, kh, w // kw, kw)
+    xb = xb.transpose(0, 1, 2, 4, 3, 5).reshape(n, c, h // kh, w // kw,
+                                                kh * kw)
+    out = jnp.max(xb, axis=-1)
+    win = jnp.argmax(xb, axis=-1)                    # index inside window
+    rows = (jax.lax.broadcasted_iota(jnp.int32, out.shape, 2) * kh
+            + win // kw)
+    cols = (jax.lax.broadcasted_iota(jnp.int32, out.shape, 3) * kw
+            + win % kw)
+    return {"Out": [out],
+            "Mask": [jax.lax.stop_gradient(rows * w + cols)]}
+
+
+@register("unpool", nondiff_inputs=("Indices",))
+def unpool(ctx, ins):
+    """Reference unpool_op.cc: scatter pooled values back to the argmax
+    positions recorded by max_pool2d_with_index (zeros elsewhere)."""
+    jnp = _jnp()
+    x, idx = ins["X"][0], ins["Indices"][0]
+    hs, ws = ctx.attr("unpool_size", None) or ctx.attr("output_size", None)
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, hs * ws), x.dtype)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].set(x.reshape(n, c, -1))
+    return {"Out": [flat.reshape(n, c, hs, ws)]}
+
+
+@register("spp")
+def spp(ctx, ins):
+    """Reference spp_op.h:35 (spatial pyramid pooling): level l pools to
+    2^l x 2^l bins with kernel=ceil(size/bins), stride=kernel,
+    pad=(kernel*bins-size+1)//2 -- window extents match the reference's
+    Pool2dFunctor exactly (windows clipped to the map; avg divides by the
+    valid count, i.e. exclusive)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    height = ctx.attr("pyramid_height", 1)
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    pieces = []
+    for level in range(height):
+        bins = 2 ** level
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        for i in range(bins):
+            h0 = max(0, i * kh - ph)
+            h1 = max(h0 + 1, min(h, i * kh - ph + kh))
+            for j in range(bins):
+                w0 = max(0, j * kw - pw)
+                w1 = max(w0 + 1, min(w, j * kw - pw + kw))
+                cell = x[:, :, h0:h1, w0:w1]
+                red = jnp.max(cell, axis=(2, 3)) if ptype == "max"                     else jnp.mean(cell, axis=(2, 3))
+                pieces.append(red.reshape(n, c, 1))
+    return {"Out": [jnp.concatenate(pieces, axis=2).reshape(n, -1)]}
+
+
+# -- conv tail ---------------------------------------------------------------
+
+@register("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(ctx, ins):
+    """Reference conv_transpose_op.cc depthwise registration: groups ==
+    channels transpose conv; reuses the grouped path of conv2d_transpose.
+    The groups override rides a COPIED ctx -- ctx.attrs is the program's
+    own attr dict and must not be mutated by lowering."""
+    from . import nn_ops
+    from ..core.registry import LowerCtx
+    x = ins["Input"][0]
+    sub = LowerCtx({**ctx.attrs, "groups": int(x.shape[1])},
+                   ctx._base_key, ctx._salt, ctx.block_runner, ctx.program,
+                   ctx.mesh, gspmd_mesh=ctx.gspmd_mesh,
+                   abstract=ctx.abstract)
+    return nn_ops.conv2d_transpose(sub, ins)
+
+
+# -- optimizer tail ----------------------------------------------------------
+
+@register("proximal_adagrad", grad=None)
+def proximal_adagrad(ctx, ins):
+    """Reference proximal_adagrad_op.h:52: m_out = m + g^2;
+    prox = p - lr * g / sqrt(m_out); the l1 threshold and l2 denominator
+    use the RAW scalar lr (only the gradient term is moment-scaled)."""
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m = ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m_out = m + g * g
+    prox = p - lr * g / jnp.sqrt(m_out)
+    if l1 > 0.0:
+        p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                 / (1.0 + lr * l2))
+    else:
+        p_out = prox / (1.0 + lr * l2)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+# -- aliases: reference op names for capabilities registered under this
+#    repo's naming -------------------------------------------------------
+
+def _register_aliases():
+    from ..core.registry import _REGISTRY, OpDef
+
+    def alias(name, target, doc):
+        t = _REGISTRY[target]
+        if name in _REGISTRY:
+            return
+        d = OpDef(name, t.lower, infer_shape=t.custom_infer_shape,
+                  grad=t.grad, nondiff_inputs=t.nondiff_inputs,
+                  nondiff_outputs=t.nondiff_outputs)
+        d.lower.__dict__.setdefault("_alias_doc", doc)
+        _REGISTRY[name] = d
+
+    # sync_batch_norm: under the GSPMD whole-program jit the batch dim is
+    # sharded over 'dp' and batch_norm's jnp.mean reductions ARE global --
+    # GSPMD inserts the cross-replica collectives the reference implements
+    # by hand in sync_batch_norm_op.cu. The alias makes that explicit.
+    alias("sync_batch_norm", "batch_norm",
+          "global-batch statistics fall out of GSPMD reductions")
+    # reference v2 names for ops this repo registered once
+    alias("multiclass_nms2", "multiclass_nms",
+          "nms2 = nms + Index output (already produced)")
+    alias("generate_mask_labels", "generate_mask_targets",
+          "reference name for the mask-target op")
+
+
+_register_aliases()

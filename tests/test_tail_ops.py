@@ -1,0 +1,304 @@
+"""Operator-library tail (round 5): numpy-oracle + gradient checks for the
+reference ops added in ops/tail_ops.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+_SELU_SCALE = 1.0507009873554805
+_SELU_ALPHA = 1.6732632423543772
+
+
+class TestSelu(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "selu"
+        x = np.linspace(-3, 3, 24).reshape(4, 6).astype("float32")
+        out = _SELU_SCALE * np.where(x > 0, x, _SELU_ALPHA * (np.exp(x) - 1))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out.astype("float32")}
+        self.attrs = {"scale": _SELU_SCALE, "alpha": _SELU_ALPHA}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestHingeLoss(OpTest):
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(0)
+        self.op_type = "hinge_loss"
+        pred = rng.randn(8, 1).astype("float32")
+        label = rng.randint(0, 2, (8, 1)).astype("float32")
+        self.inputs = {"Logits": pred, "Labels": label}
+        self.outputs = {"Loss": np.maximum(
+            1 - pred * (2 * label - 1), 0).astype("float32")}
+
+    def test(self):
+        self.check_output()
+
+
+class TestModifiedHuber(OpTest):
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(1)
+        self.op_type = "modified_huber_loss"
+        pred = (rng.randn(10, 1) * 2).astype("float32")
+        label = rng.randint(0, 2, (10, 1)).astype("float32")
+        z = pred * (2 * label - 1)
+        loss = np.where(z >= -1, np.square(np.maximum(1 - z, 0)), -4 * z)
+        self.inputs = {"X": pred, "Y": label}
+        self.outputs = {"Out": loss.astype("float32"),
+                        "IntermediateVal": z.astype("float32")}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSquaredL2Distance(OpTest):
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(2)
+        self.op_type = "squared_l2_distance"
+        x = rng.randn(5, 4).astype("float32")
+        y = rng.randn(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.sum((x - y) ** 2, -1,
+                                      keepdims=True).astype("float32"),
+                        "sub_result": (x - y).astype("float32")}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestL1Norm(OpTest):
+    def setUp(self):
+        super().setUp()
+        rng = np.random.RandomState(3)
+        self.op_type = "l1_norm"
+        x = rng.randn(4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.abs(x).sum().reshape(1).astype("float32")}
+
+    def test(self):
+        self.check_output()
+
+
+class TestMinusAndNorm(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "minus"
+        rng = np.random.RandomState(4)
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x - y).astype("float32")}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestNormOp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "norm"
+        rng = np.random.RandomState(5)
+        x = rng.randn(3, 6).astype("float32")
+        n = np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": (x / n).astype("float32"),
+                        "Norm": n.astype("float32")}
+        self.attrs = {"axis": 1, "epsilon": 1e-10}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestConvShift(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "conv_shift"
+        rng = np.random.RandomState(6)
+        B, N, M = 3, 7, 3
+        x = rng.randn(B, N).astype("float32")
+        y = rng.randn(B, M).astype("float32")
+        out = np.zeros((B, N), "float32")
+        half = (M - 1) // 2
+        for b in range(B):
+            for i in range(N):
+                for j in range(M):
+                    out[b, i] += x[b, (i + j - half) % N] * y[b, j]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+def test_size_fill_crop_fc_cvm():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        block = main.global_block()
+        x = fluid.data("x", [3, 4, 5], "float32", append_batch_size=False)
+        sz = block.create_var("sz", [1], "int32")
+        block.append_op("size", inputs={"Input": ["x"]},
+                        outputs={"Out": ["sz"]})
+        fl = block.create_var("fl", [2, 2], "float32")
+        block.append_op("fill", outputs={"Out": ["fl"]},
+                        attrs={"shape": [2, 2], "dtype": "float32",
+                               "value": [1.0, 2.0, 3.0, 4.0]},
+                        infer_shape=False)
+        cr = block.create_var("cr", [2, 2, 2], "float32")
+        block.append_op("crop", inputs={"X": ["x"]}, outputs={"Out": ["cr"]},
+                        attrs={"shape": [2, 2, 2], "offsets": [1, 1, 2]},
+                        infer_shape=False)
+        w = fluid.layers.tensor.create_parameter([20, 7], "float32",
+                                                 name="fcw")
+        fc_out = block.create_var("fc_out", [3, 7], "float32")
+        block.append_op("fc", inputs={"Input": ["x"], "W": ["fcw"]},
+                        outputs={"Out": ["fc_out"]},
+                        attrs={"in_num_col_dims": 1}, infer_shape=False)
+        c = fluid.data("c", [4, 6], "float32", append_batch_size=False)
+        cv = block.create_var("cv", [4, 6], "float32")
+        block.append_op("cvm", inputs={"X": ["c"]}, outputs={"Y": ["cv"]},
+                        attrs={"use_cvm": True}, infer_shape=False)
+        cv2 = block.create_var("cv2", [4, 4], "float32")
+        block.append_op("cvm", inputs={"X": ["c"]}, outputs={"Y": ["cv2"]},
+                        attrs={"use_cvm": False}, infer_shape=False)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(3, 4, 5).astype("float32")
+    cvv = np.abs(rng.randn(4, 6)).astype("float32")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        szv, flv, crv, fcv, cva, cvb = exe.run(
+            main, feed={"x": xv, "c": cvv},
+            fetch_list=["sz", "fl", "cr", "fc_out", "cv", "cv2"])
+    assert int(np.asarray(szv)[0]) == 60
+    np.testing.assert_allclose(np.asarray(flv),
+                               [[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(crv), xv[1:3, 1:3, 2:4])
+    np.testing.assert_allclose(np.asarray(cva)[:, 0],
+                               np.log(cvv[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(cva)[:, 1],
+                               np.log(cvv[:, 1] + 1) - np.log(cvv[:, 0] + 1),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cva)[:, 2:], cvv[:, 2:])
+    np.testing.assert_allclose(np.asarray(cvb), cvv[:, 2:])
+    assert np.asarray(fcv).shape == (3, 7)
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    """pool-with-index records flat argmax positions; unpool scatters the
+    pooled values back (reference unpool_op.cc roundtrip contract)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        block = main.global_block()
+        x = fluid.data("x", [2, 3, 4, 4], "float32", append_batch_size=False)
+        out = block.create_var("out", [2, 3, 2, 2], "float32")
+        mask = block.create_var("mask", [2, 3, 2, 2], "int32")
+        block.append_op("max_pool2d_with_index", inputs={"X": ["x"]},
+                        outputs={"Out": ["out"], "Mask": ["mask"]},
+                        attrs={"ksize": [2, 2], "strides": [2, 2]},
+                        infer_shape=False)
+        up = block.create_var("up", [2, 3, 4, 4], "float32")
+        block.append_op("unpool", inputs={"X": ["out"],
+                                          "Indices": ["mask"]},
+                        outputs={"Out": ["up"]},
+                        attrs={"unpool_size": [4, 4]}, infer_shape=False)
+    rng = np.random.RandomState(7)
+    xv = rng.randn(2, 3, 4, 4).astype("float32")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        ov, mv, uv = exe.run(main, feed={"x": xv},
+                             fetch_list=["out", "mask", "up"])
+    ov, mv, uv = map(np.asarray, (ov, mv, uv))
+    # oracle: torch-style non-overlapping pool
+    want = xv.reshape(2, 3, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5) \
+        .reshape(2, 3, 2, 2, 4).max(-1)
+    np.testing.assert_allclose(ov, want, rtol=1e-6)
+    # mask flat indices point at the max value in the input map
+    flat = xv.reshape(2, 3, 16)
+    for n in range(2):
+        for ch in range(3):
+            np.testing.assert_allclose(
+                flat[n, ch][mv[n, ch].ravel()], ov[n, ch].ravel())
+    # unpool puts each pooled value back at its argmax position
+    assert uv.shape == xv.shape
+    np.testing.assert_allclose(uv.reshape(2, 3, 16).sum(-1),
+                               ov.reshape(2, 3, 4).sum(-1), rtol=1e-5)
+
+
+def test_spp_pyramid():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        block = main.global_block()
+        x = fluid.data("x", [2, 3, 5, 7], "float32", append_batch_size=False)
+        out = block.create_var("out", [2, 3 * (1 + 4)], "float32")
+        block.append_op("spp", inputs={"X": ["x"]}, outputs={"Out": ["out"]},
+                        attrs={"pyramid_height": 2, "pooling_type": "max"},
+                        infer_shape=False)
+    rng = np.random.RandomState(8)
+    xv = rng.randn(2, 3, 5, 7).astype("float32")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        ov, = exe.run(main, feed={"x": xv}, fetch_list=["out"])
+    ov = np.asarray(ov).reshape(2, 3, 5)
+    # level 0 = global max over each channel
+    np.testing.assert_allclose(ov[:, :, 0], xv.max(axis=(2, 3)), rtol=1e-6)
+    # level 1: reference windows with kernel=ceil(size/2), pad from spp_op.h
+    kh, kw = 3, 4
+    ph, pw = (kh * 2 - 5 + 1) // 2, (kw * 2 - 7 + 1) // 2
+    for i in range(2):
+        for j in range(2):
+            h0, h1 = max(0, i * kh - ph), min(5, i * kh - ph + kh)
+            w0, w1 = max(0, j * kw - pw), min(7, j * kw - pw + kw)
+            np.testing.assert_allclose(
+                ov[:, :, 1 + i * 2 + j],
+                xv[:, :, h0:h1, w0:w1].max(axis=(2, 3)), rtol=1e-6)
+
+
+def test_proximal_adagrad_step():
+    p = np.array([1.0, -2.0, 0.01], "float32")
+    g = np.array([0.5, 0.5, 0.5], "float32")
+    m = np.array([1.0, 1.0, 1.0], "float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        block = main.global_block()
+        for nm, v in (("p", p), ("g", g), ("m", m)):
+            block.create_var(nm, list(v.shape), "float32", is_data=True)
+        block.create_var("lr", [1], "float32", is_data=True)
+        block.create_var("po", [3], "float32")
+        block.create_var("mo", [3], "float32")
+        block.append_op("proximal_adagrad",
+                        inputs={"Param": ["p"], "Grad": ["g"],
+                                "Moment": ["m"], "LearningRate": ["lr"]},
+                        outputs={"ParamOut": ["po"], "MomentOut": ["mo"]},
+                        attrs={"l1": 0.1, "l2": 0.01}, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        pov, mov = exe.run(main, feed={"p": p, "g": g, "m": m,
+                                       "lr": np.array([0.1], "float32")},
+                           fetch_list=["po", "mo"])
+    m_out = m + g * g
+    prox = p - 0.1 * g / np.sqrt(m_out)
+    want = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.1, 0)
+            / (1 + 0.1 * 0.01))
+    np.testing.assert_allclose(np.asarray(mov), m_out, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pov), want, rtol=1e-5)
+
+
+def test_aliases_resolve_and_sync_bn_matches_bn():
+    from paddle_tpu.core.registry import get
+    for name in ("sync_batch_norm", "multiclass_nms2",
+                 "generate_mask_labels"):
+        get(name)
+    # sync_batch_norm IS batch_norm under GSPMD (global stats fall out of
+    # the sharded-batch reduction): identical lowering object
+    assert get("sync_batch_norm").lower is get("batch_norm").lower
