@@ -797,6 +797,80 @@ def accuracy(input, label, k=1, correct=None, total=None):
     return _var(helper, acc)
 
 
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """Reference nn.py:16751 — deformable convolution (v2 when modulated,
+    v1 otherwise). im2col_step is accepted for parity and ignored: the
+    lowering vectorizes the whole batch (ops/tail_ops.py)."""
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    c_in = input.shape[1]
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    fh, fw = (filter_size if isinstance(filter_size, (list, tuple))
+              else (filter_size, filter_size))
+    w = helper.create_parameter(param_attr,
+                                [num_filters, c_in // groups, fh, fw],
+                                input.dtype)
+    out = _out(helper, input.dtype)
+    inputs = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        if mask is None:
+            raise ValueError("deformable_conv(modulated=True) needs a mask "
+                             "(pass modulated=False for the v1 form)")
+        inputs["Mask"] = [mask]
+    helper.append_op(
+        op_type, inputs=inputs, outputs={"Output": [out]},
+        attrs={"strides": [stride, stride] if isinstance(stride, int)
+               else list(stride),
+               "paddings": [padding, padding] if isinstance(padding, int)
+               else list(padding),
+               "dilations": [dilation, dilation] if isinstance(dilation, int)
+               else list(dilation),
+               "groups": groups, "deformable_groups": deformable_groups})
+    pre_act = _var(helper, out)
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out2 = _out(helper, input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [pre_act], "Y": [b]},
+                         outputs={"Out": [out2]}, attrs={"axis": 1})
+        pre_act = _var(helper, out2)
+    return pre_act
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Reference nn.py:2051 — chunk-level precision/recall/F1 for sequence
+    tagging (NER-style). input/label: padded [B, T] tag ids with the
+    optional seq_length [B] giving true lengths (this repo's length-aware
+    replacement for the reference's LoD input). Returns the reference's
+    6-tuple (precision, recall, f1, num_infer, num_label, num_correct)."""
+    helper = LayerHelper("chunk_eval")
+    outs = {n: _out(helper, dt, stop_gradient=True)
+            for n, dt in (("Precision", "float32"), ("Recall", "float32"),
+                          ("F1-Score", "float32"),
+                          ("NumInferChunks", "int32"),
+                          ("NumLabelChunks", "int32"),
+                          ("NumCorrectChunks", "int32"))}
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        "chunk_eval", inputs=inputs,
+        outputs={k: [v] for k, v in outs.items()},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return tuple(_var(helper, outs[k]) for k in
+                 ("Precision", "Recall", "F1-Score", "NumInferChunks",
+                  "NumLabelChunks", "NumCorrectChunks"))
+
+
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
     from ..initializer import Constant
     helper = LayerHelper("auc")

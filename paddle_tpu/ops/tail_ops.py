@@ -115,7 +115,7 @@ def fill(ctx, ins):
     jnp = _jnp()
     from ..framework import convert_dtype
     shape = ctx.attr("shape", [])
-    dtype = convert_dtype(ctx.attr("dtype", 5))
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
     vals = np.asarray(ctx.attr("value", []), dtype="float64")
     return {"Out": [jnp.asarray(vals.reshape(shape), dtype=dtype)]}
 
@@ -202,12 +202,13 @@ def max_pool2d_with_index(ctx, ins):
     k = ctx.attr("ksize", [2, 2])
     s = ctx.attr("strides", k) or k
     p = ctx.attr("paddings", [0, 0]) or [0, 0]
-    if list(k) != list(s) or any(p):
-        raise NotImplementedError(
-            "max_pool2d_with_index: non-overlapping unpadded windows only "
-            "(stride == ksize); use pool2d for plain max pooling")
     n, c, h, w = x.shape
     kh, kw = int(k[0]), int(k[1])
+    if list(k) != list(s) or any(p) or h % kh or w % kw:
+        raise NotImplementedError(
+            "max_pool2d_with_index: non-overlapping unpadded windows over "
+            "divisible maps only (stride == ksize, H % kh == W % kw == 0); "
+            "use pool2d for plain max pooling")
     xb = x.reshape(n, c, h // kh, kh, w // kw, kw)
     xb = xb.transpose(0, 1, 2, 4, 3, 5).reshape(n, c, h // kh, w // kw,
                                                 kh * kw)
@@ -227,8 +228,15 @@ def unpool(ctx, ins):
     positions recorded by max_pool2d_with_index (zeros elsewhere)."""
     jnp = _jnp()
     x, idx = ins["X"][0], ins["Indices"][0]
-    hs, ws = ctx.attr("unpool_size", None) or ctx.attr("output_size", None)
     n, c, h, w = x.shape
+    out_size = ctx.attr("unpool_size", None) or ctx.attr("output_size", None)
+    if out_size is None:
+        # reference unpool_op.cc default: out = (in - 1) * stride + ksize
+        k = ctx.attr("ksize", [2, 2])
+        st = ctx.attr("strides", k) or k
+        out_size = [(h - 1) * int(st[0]) + int(k[0]),
+                    (w - 1) * int(st[1]) + int(k[1])]
+    hs, ws = int(out_size[0]), int(out_size[1])
     flat = jnp.zeros((n, c, hs * ws), x.dtype)
     flat = flat.at[
         jnp.arange(n)[:, None, None],
@@ -257,10 +265,10 @@ def spp(ctx, ins):
         ph = (kh * bins - h + 1) // 2
         pw = (kw * bins - w + 1) // 2
         for i in range(bins):
-            h0 = max(0, i * kh - ph)
+            h0 = min(max(0, i * kh - ph), h - 1)
             h1 = max(h0 + 1, min(h, i * kh - ph + kh))
             for j in range(bins):
-                w0 = max(0, j * kw - pw)
+                w0 = min(max(0, j * kw - pw), w - 1)
                 w1 = max(w0 + 1, min(w, j * kw - pw + kw))
                 cell = x[:, :, h0:h1, w0:w1]
                 red = jnp.max(cell, axis=(2, 3)) if ptype == "max"                     else jnp.mean(cell, axis=(2, 3))
@@ -339,3 +347,95 @@ def _register_aliases():
 
 
 _register_aliases()
+
+
+# -- deformable convolution ---------------------------------------------------
+
+def _bilinear_sample_nchw(x, py, px):
+    """Bilinear sample x [N, C, H, W] at float coords py/px [N, S] per
+    batch; out-of-bounds contributes zero (the reference's im2col border
+    rule). Returns [N, C, S]."""
+    jnp = _jnp()
+    import jax
+    n, c, h, w = x.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+    out = 0.0
+    for dy, dx in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        yy = y0 + dy
+        xx = x0 + dx
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        flat = x.reshape(n, c, h * w)
+        idx = yc * w + xc                              # [N, S]
+        vals = jnp.take_along_axis(flat, idx[:, None, :].repeat(c, axis=1),
+                                   axis=2)
+        wgt = ((wy if dy else (1.0 - wy)) * (wx if dx else (1.0 - wx))
+               * valid.astype(x.dtype))
+        out = out + vals * wgt[:, None, :]
+    return out
+
+
+@register("deformable_conv")
+def deformable_conv(ctx, ins):
+    """Reference deformable_conv_op.cc (v2, modulated): each kernel tap k
+    samples the input at p0 + p_k + offset[n, 2k:2k+2, p0] with bilinear
+    interpolation, scaled by Mask, then contracts with the filter. The
+    CUDA modulated_deformable_im2col collapses into one vectorized
+    bilinear-gather + einsum."""
+    jnp = _jnp()
+    x, off, w = ins["Input"][0], ins["Offset"][0], ins["Filter"][0]
+    mask = ins.get("Mask", [None])[0]
+    strides = ctx.attr("strides", [1, 1]) or [1, 1]
+    pads = ctx.attr("paddings", [0, 0]) or [0, 0]
+    dil = ctx.attr("dilations", [1, 1]) or [1, 1]
+    groups = int(ctx.attr("groups", 1) or 1)
+    dg = int(ctx.attr("deformable_groups", 1) or 1)
+    n, cin, h, wd = x.shape
+    cout, cpg, kh, kw = w.shape
+    ho = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    wo = (wd + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+    K = kh * kw
+    import jax
+    base_y = (jax.lax.broadcasted_iota(jnp.float32, (ho, wo), 0)
+              * strides[0] - pads[0])
+    base_x = (jax.lax.broadcasted_iota(jnp.float32, (ho, wo), 1)
+              * strides[1] - pads[1])
+    off = off.reshape(n, dg, K, 2, ho, wo).astype(jnp.float32)
+    cols = []
+    cg = cin // dg
+    for g in range(dg):
+        xg = x[:, g * cg:(g + 1) * cg]
+        taps = []
+        for ki in range(kh):
+            for kj in range(kw):
+                k = ki * kw + kj
+                py = base_y[None] + ki * dil[0] + off[:, g, k, 0]
+                px = base_x[None] + kj * dil[1] + off[:, g, k, 1]
+                s = _bilinear_sample_nchw(xg, py.reshape(n, -1),
+                                          px.reshape(n, -1))
+                if mask is not None:
+                    m = mask.reshape(n, dg, K, ho, wo)[:, g, k]
+                    s = s * m.reshape(n, 1, -1).astype(s.dtype)
+                taps.append(s)                        # [N, cg, Ho*Wo]
+        cols.append(jnp.stack(taps, axis=2))          # [N, cg, K, S]
+    col = jnp.concatenate(cols, axis=1)               # [N, Cin, K, S]
+    # grouped contraction with the filter; full-f32 accumulation (the
+    # reference kernel is f32 -- TPU's default multi-pass bf16 matmul would
+    # cost ~1e-3 here)
+    out = jnp.einsum("ngcks,gock->ngos",
+                     col.reshape(n, groups, cin // groups, K, ho * wo),
+                     w.reshape(groups, cout // groups, cin // groups, K),
+                     precision="highest")
+    return {"Output": [out.reshape(n, cout, ho, wo).astype(x.dtype)]}
+
+
+@register("deformable_conv_v1")
+def deformable_conv_v1(ctx, ins):
+    """Reference deformable_conv_v1_op.cc: the unmodulated form (no Mask)."""
+    ins = dict(ins)
+    ins.pop("Mask", None)
+    return deformable_conv(ctx, ins)

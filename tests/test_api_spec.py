@@ -19,8 +19,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # surface (VERDICT r3 layer diff). Each has a SCOPE.md row; if one of these
 # gets implemented, remove it here so the gap list stays truthful.
 KNOWN_MISSING_LAYERS = {
-    "chunk_eval",
-    "deformable_conv",
     "deformable_roi_pooling",
     "filter_by_instag",
     "prroi_pool",

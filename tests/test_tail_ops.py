@@ -302,3 +302,263 @@ def test_aliases_resolve_and_sync_bn_matches_bn():
     # sync_batch_norm IS batch_norm under GSPMD (global stats fall out of
     # the sharded-batch reduction): identical lowering object
     assert get("sync_batch_norm").lower is get("batch_norm").lower
+
+
+def test_chunk_eval_iob():
+    """chunk_eval (reference chunk_eval_op.cc, IOB): hand-built sequences
+    with known chunk sets; padded positions beyond SeqLength are ignored."""
+    # IOB, 2 chunk types: tags B-0=0 I-0=1 B-1=2 I-1=3, O=4 (=num_types*2..)
+    # seq 1 (len 5): label chunks: [0,1]:t0, [3,3]:t1
+    lab1 = [0, 1, 4, 2, 4]
+    # pred: [0,1]:t0 (correct), [3,4]:t1 (wrong end)
+    inf1 = [0, 1, 4, 2, 3]
+    # seq 2 (len 4, padded to 5): label [0,0]:t1, [2,3]:t0
+    lab2 = [2, 4, 0, 1, 0]   # last position is padding (ignored)
+    inf2 = [2, 4, 0, 1, 1]   # identical within length -> 2 correct
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        inf = fluid.data("inf", [2, 5], "int64", append_batch_size=False)
+        lab = fluid.data("lab", [2, 5], "int64", append_batch_size=False)
+        ln = fluid.data("len", [2], "int64", append_batch_size=False)
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=2, seq_length=ln)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        res = exe.run(main, feed={
+            "inf": np.array([inf1, inf2], "int64"),
+            "lab": np.array([lab1, lab2], "int64"),
+            "len": np.array([5, 4], "int64")},
+            fetch_list=[p, r, f1, ni, nl, nc])
+    pv, rv, fv, niv, nlv, ncv = [np.asarray(v).ravel()[0] for v in res]
+    assert (niv, nlv, ncv) == (4, 4, 3), (niv, nlv, ncv)
+    np.testing.assert_allclose(pv, 3 / 4, rtol=1e-6)
+    np.testing.assert_allclose(rv, 3 / 4, rtol=1e-6)
+    np.testing.assert_allclose(fv, 2 * 0.75 * 0.75 / 1.5, rtol=1e-6)
+
+
+def test_chunk_eval_excluded_and_plain():
+    from paddle_tpu.ops.metrics_ops import _chunk_segments
+    # plain scheme: every non-other tag is a single-token chunk of its type
+    assert _chunk_segments([0, 1, 2, 1], "plain", 2) == [
+        (0, 0, 0), (1, 1, 1), (3, 3, 1)]
+    # IOBES: B I E -> one chunk; S -> singleton
+    assert _chunk_segments([0, 1, 2, 3, 8], "IOBES", 2) == [
+        (0, 2, 0), (3, 3, 0)]
+
+
+def _deform_oracle(x, off, mask, w, stride, pad, dil, groups, dg):
+    """Naive reference-rule implementation (deformable_conv_op.cc)."""
+    n, cin, h, wd = x.shape
+    cout, cpg, kh, kw = w.shape
+    ho = (h + 2 * pad - (dil * (kh - 1) + 1)) // stride + 1
+    wo = (wd + 2 * pad - (dil * (kw - 1) + 1)) // stride + 1
+    K = kh * kw
+    offr = off.reshape(n, dg, K, 2, ho, wo)
+    out = np.zeros((n, cout, ho, wo), np.float64)
+    cg_in, cg_out, cdg = cin // groups, cout // groups, cin // dg
+
+    def sample(img, y, xq):
+        hh, ww = img.shape
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        val = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xx = y0 + dy, x0 + dx
+                if 0 <= yy < hh and 0 <= xx < ww:
+                    val += img[yy, xx] * \
+                        (y - y0 if dy else 1 - (y - y0)) * \
+                        (xq - x0 if dx else 1 - (xq - x0))
+        return val
+
+    for b in range(n):
+        for oc in range(cout):
+            g = oc // cg_out
+            for i in range(ho):
+                for j in range(wo):
+                    acc = 0.0
+                    for ic in range(cg_in):
+                        ci = g * cg_in + ic
+                        gd = ci // cdg
+                        for ki in range(kh):
+                            for kj in range(kw):
+                                k = ki * kw + kj
+                                py = (i * stride - pad + ki * dil
+                                      + offr[b, gd, k, 0, i, j])
+                                px = (j * stride - pad + kj * dil
+                                      + offr[b, gd, k, 1, i, j])
+                                v = sample(x[b, ci], py, px)
+                                if mask is not None:
+                                    v *= mask.reshape(
+                                        n, dg, K, ho, wo)[b, gd, k, i, j]
+                                acc += v * w[oc, ic, ki, kj]
+                    out[b, oc, i, j] = acc
+    return out
+
+
+@pytest.mark.parametrize("modulated", [True, False])
+def test_deformable_conv_matches_naive_oracle(modulated):
+    rng = np.random.RandomState(0)
+    n, cin, h, wd = 2, 4, 5, 5
+    cout, kh, kw = 4, 3, 3
+    groups, dg = 2, 2
+    x = rng.randn(n, cin, h, wd).astype("float32")
+    w = (rng.randn(cout, cin // groups, kh, kw) * 0.3).astype("float32")
+    off = (rng.randn(n, 2 * dg * kh * kw, 5, 5) * 0.7).astype("float32")
+    mask = rng.rand(n, dg * kh * kw, 5, 5).astype("float32") if modulated \
+        else None
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        block = main.global_block()
+        feeds = {"x": x, "off": off, "w": w}
+        inputs = {"Input": ["x"], "Offset": ["off"], "Filter": ["w"]}
+        for nm, arr in feeds.items():
+            block.create_var(nm, list(arr.shape), "float32", is_data=True)
+        if modulated:
+            block.create_var("mask", list(mask.shape), "float32",
+                             is_data=True)
+            feeds["mask"] = mask
+            inputs["Mask"] = ["mask"]
+        block.create_var("out", [n, cout, 5, 5], "float32")
+        block.append_op("deformable_conv" if modulated
+                        else "deformable_conv_v1",
+                        inputs=inputs, outputs={"Output": ["out"]},
+                        attrs={"strides": [1, 1], "paddings": [1, 1],
+                               "dilations": [1, 1], "groups": groups,
+                               "deformable_groups": dg}, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        got, = exe.run(main, feed=feeds, fetch_list=["out"])
+    want = _deform_oracle(x, off, mask, w, 1, 1, 1, groups, dg)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_deformable_conv_zero_offset_is_plain_conv():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.tail_ops import deformable_conv as dc
+    from paddle_tpu.core.registry import LowerCtx
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    w = (rng.randn(3, 2, 3, 3) * 0.3).astype("float32")
+    off = np.zeros((1, 18, 6, 6), "float32")
+    ctx = LowerCtx({"strides": [1, 1], "paddings": [1, 1],
+                    "dilations": [1, 1], "groups": 1,
+                    "deformable_groups": 1})
+    out = dc(ctx, {"Input": [jnp.asarray(x)], "Offset": [jnp.asarray(off)],
+                   "Filter": [jnp.asarray(w)]})["Output"][0]
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_deformable_conv_layer_trains():
+    """Layer-level deformable_conv: builds the v2 op chain, and gradients
+    flow to input, offsets, mask and filter (bilinear sampling is
+    differentiable through the auto-vjp)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 4, 6, 6], "float32", append_batch_size=False)
+        off = fluid.layers.conv2d(x, 18, 3, padding=1, bias_attr=False)
+        m = fluid.layers.sigmoid(
+            fluid.layers.conv2d(x, 9, 3, padding=1, bias_attr=False))
+        y = fluid.layers.deformable_conv(x, off, m, num_filters=8,
+                                         filter_size=3, padding=1,
+                                         deformable_groups=1)
+        loss = fluid.layers.mean(fluid.layers.square(y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(2, 4, 6, 6).astype("float32")}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        vals = [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0]).ravel()[0])
+                for _ in range(80)]
+    assert vals[-1] < vals[0] * 0.7, (vals[0], vals[-1])
+
+
+@pytest.mark.parametrize("scheme,nct", [("IOB", 3), ("IOE", 2),
+                                        ("IOBES", 2), ("plain", 3)])
+def test_chunk_eval_vectorized_matches_sequential_rules(scheme, nct):
+    """The vectorized chunk_eval lowering must agree with the sequential
+    reference-rule parser (_chunk_segments) on random tag sequences, for
+    every scheme -- counts, precision, recall."""
+    from paddle_tpu.ops.metrics_ops import _chunk_segments
+
+    num_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    hi = nct * num_tag + 1          # includes the Other tag
+    rng = np.random.RandomState(
+        {"IOB": 11, "IOE": 22, "IOBES": 33, "plain": 44}[scheme])
+    B, T = 6, 12
+    inf = rng.randint(0, hi, (B, T)).astype("int64")
+    lab = rng.randint(0, hi, (B, T)).astype("int64")
+    lens = rng.randint(3, T + 1, B).astype("int64")
+
+    n_inf = n_lab = n_cor = 0
+    for b in range(B):
+        L = int(lens[b])
+        si = set(_chunk_segments(inf[b, :L], scheme, nct))
+        sl = set(_chunk_segments(lab[b, :L], scheme, nct))
+        n_inf += len(si)
+        n_lab += len(sl)
+        n_cor += len(si & sl)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        iv = fluid.data("iv", [B, T], "int64", append_batch_size=False)
+        lv = fluid.data("lv", [B, T], "int64", append_batch_size=False)
+        ln = fluid.data("ln", [B], "int64", append_batch_size=False)
+        outs = fluid.layers.chunk_eval(iv, lv, scheme, nct, seq_length=ln)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        res = exe.run(main, feed={"iv": inf, "lv": lab, "ln": lens},
+                      fetch_list=list(outs))
+    p, r, f1, ni, nl, nc = [np.asarray(v).ravel()[0] for v in res]
+    assert (int(ni), int(nl), int(nc)) == (n_inf, n_lab, n_cor), (
+        scheme, (int(ni), int(nl), int(nc)), (n_inf, n_lab, n_cor))
+
+
+def test_depthwise_conv2d_transpose_matches_grouped():
+    """depthwise_conv2d_transpose == conv2d_transpose with groups=C (and
+    the lowering must NOT write the groups override into the program's own
+    attr dict)."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import LowerCtx, get
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    w = (rng.randn(3, 1, 3, 3) * 0.4).astype("float32")
+    attrs = {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1]}
+    ctx = LowerCtx(dict(attrs))
+    out = get("depthwise_conv2d_transpose").lower(
+        ctx, {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]}
+    )["Output"][0]
+    assert "groups" not in ctx.attrs  # no side effect on the op desc
+    ctx2 = LowerCtx({**attrs, "groups": 3})
+    ref = get("conv2d_transpose").lower(
+        ctx2, {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]}
+    )["Output"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spp_tiny_map_and_unpool_default_size():
+    """spp must survive maps smaller than the finest grid (clamped
+    reference windows); unpool without unpool_size derives
+    (in-1)*stride+ksize like the reference."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import LowerCtx, get
+    x = jnp.asarray(np.arange(8, dtype="float32").reshape(1, 2, 2, 2))
+    out = get("spp").lower(LowerCtx({"pyramid_height": 3,
+                                     "pooling_type": "max"}),
+                           {"X": [x]})["Out"][0]
+    assert out.shape == (1, 2 * (1 + 4 + 16))
+    pooled = jnp.asarray([[[[5.0]]]])
+    idx = jnp.asarray([[[[3]]]], dtype="int32")
+    up = get("unpool").lower(LowerCtx({"ksize": [2, 2]}),
+                             {"X": [pooled], "Indices": [idx]})["Out"][0]
+    assert up.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(np.asarray(up).ravel(), [0, 0, 0, 5.0])
