@@ -204,6 +204,34 @@ class Executor:
                        for v in (fetch_list or [])]
         scope = scope or global_scope()
 
+        # PS schedule hoisting (ops/host_table.py): eligible host-table
+        # pulls run as host gathers BEFORE the compiled step (rows enter as
+        # feeds) and pushes as host updates AFTER it (row grads fetched) --
+        # no jax callbacks in the compiled program (the axon TPU backend
+        # has none). Sharded (shard_axis) tables and dist-strategy runs
+        # keep the in-graph callback path.
+        host_pushes = []
+        if compiled_wrapper is None or not compiled_wrapper.dist_strategy:
+            hkey = (id(program), program._version)
+            hcache = getattr(self, "_hoist_cache", None)
+            if hcache is None:
+                hcache = self._hoist_cache = {}
+            entry = hcache.get(hkey)
+            if entry is None or entry[0] is not program:
+                from ..ops import host_table as _ht
+                entry = (program,) + _ht.hoist_host_pulls(program)
+                hcache[hkey] = entry
+                while len(hcache) > self._CACHE_CAP:
+                    hcache.pop(next(iter(hcache)))
+            _, hprog, pulls, pushes = entry
+            if pulls:
+                from ..ops import host_table as _ht
+                program = hprog
+                feed = _ht.run_pulls(pulls, feed)
+                # pushes train the table -- never on fetch-pruned (eval)
+                # runs, where the old in-graph push was pruned away too
+                host_pushes = [] if use_prune else pushes
+
         if use_prune and fetch_names:
             # Fetch-graph pruning (reference executor.py _prune_program): run only
             # the ops needed to produce the fetches — eval-style fetches must not
@@ -220,6 +248,11 @@ class Executor:
                 while len(self._prune_cache) > self._CACHE_CAP:
                     self._prune_cache.pop(next(iter(self._prune_cache)))
             program = entry[1]
+
+        n_user_fetch = len(fetch_names)
+        if host_pushes:
+            fetch_names = fetch_names + [
+                g for (_, _, g, _) in host_pushes if g not in fetch_names]
 
         if compiled_wrapper is not None and compiled_wrapper.dist_strategy:
             ds = compiled_wrapper.dist_strategy
@@ -342,6 +375,12 @@ class Executor:
                 raise FloatingPointError(
                     f"NaN/Inf detected in state vars {bad[:5]} after run "
                     f"(FLAGS_check_nan_inf)")
+        if host_pushes:
+            from ..ops import host_table as _ht
+            fetched = dict(feed)
+            fetched.update(zip(fetch_names, fetches))
+            _ht.run_pushes(host_pushes, fetched)
+            fetches = fetches[:n_user_fetch]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

@@ -823,6 +823,10 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
             raise ValueError("deformable_conv(modulated=True) needs a mask "
                              "(pass modulated=False for the v1 form)")
         inputs["Mask"] = [mask]
+    elif mask is not None:
+        raise ValueError("deformable_conv(modulated=False) is the v1 form "
+                         "and takes no mask (the reference asserts the "
+                         "same); pass mask=None")
     helper.append_op(
         op_type, inputs=inputs, outputs={"Output": [out]},
         attrs={"strides": [stride, stride] if isinstance(stride, int)

@@ -563,3 +563,98 @@ def _host_push(ctx, ins):
                         jax.ShapeDtypeStruct((1,), jnp.float32),
                         ids, g, ordered=False)
     return {"Anchor@GRAD": [token]}
+
+
+# --------------------------------------------------------------------------------------
+# Pull/push hoisting: the PS schedule without in-graph callbacks
+# --------------------------------------------------------------------------------------
+
+def hoist_host_pulls(program):
+    """Rewrite eligible host-table ops OUT of the compiled program: the pull
+    becomes a host-side gather whose rows enter as a feed, the push becomes
+    a fetch of the row gradients applied to the table after the step. This
+    is the reference PS schedule itself (pull -> device step -> push,
+    distribute_transpiler.py:1594) and removes jax callbacks from the hot
+    path -- required on backends without host-callback support (the axon
+    TPU relay) and strictly less per-step overhead elsewhere.
+
+    Eligible: non-row-sharded lookups whose Ids come straight from a feed
+    (the CTR DataFeed pattern). Sharded (shard_axis) lookups keep the
+    in-graph per-process callbacks.
+
+    Returns (program_copy, pulls, pushes) -- or (program, [], []) when
+    nothing is eligible. pulls: [(table, ids_feed, out_var)];
+    pushes: [(table, ids_feed, grad_var, anchor_grad_var)].
+    """
+    from ..framework import Program
+
+    if not any(op.type == "host_lookup_table"
+               for op in program.global_block().ops):
+        return program, [], []
+
+    p2 = Program.from_dict(program.to_dict())
+    p2.random_seed = program.random_seed
+    b2 = p2.global_block()
+    pulls, pushes, drop = [], [], set()
+    # single eligibility filter, applied once over the copy (op order is
+    # preserved by the dict round-trip)
+    for op in list(b2.ops):
+        if op.type == "host_lookup_table" and not op.attr("shard_axis",
+                                                          None):
+            ids_name = op.inputs["Ids"][0]
+            iv = b2.find_var_recursive(ids_name)
+            if iv is None or not iv.is_data:
+                continue
+            out = op.outputs["Out"][0]
+            b2.find_var_recursive(out).is_data = True
+            pulls.append((op.attr("table_name"), ids_name, out))
+            drop.add(id(op))
+    if not pulls:
+        return program, [], []
+    pull_keys = {(t, i) for t, i, _ in pulls}
+    for idx, op in enumerate(list(b2.ops)):
+        if op.type == "host_push_grad":
+            key = (op.attr("table_name"), op.inputs["Ids"][0])
+            if key not in pull_keys:
+                continue
+            anchor_grad = op.outputs["Anchor@GRAD"][0]
+            pushes.append((op.attr("table_name"), op.inputs["Ids"][0],
+                           op.inputs["OutGrad"][0], anchor_grad))
+            drop.add(id(op))
+            # the anchor's optimizer update still consumes Anchor@GRAD:
+            # it is identically zero (the anchor never receives real
+            # gradient), so materialize the zeros the push op used to emit
+            av = b2.find_var_recursive(anchor_grad[:-5])
+            zop = type(op)(
+                b2, "fill_constant", inputs={},
+                outputs={"Out": [anchor_grad]},
+                attrs={"shape": list(av.shape) if av is not None else [1],
+                       "dtype": "float32", "value": 0.0})
+            b2.ops[idx] = zop
+            drop.discard(id(zop))
+    b2.ops = [o for o in b2.ops if id(o) not in drop]
+    return p2, pulls, pushes
+
+
+def run_pulls(pulls, feed):
+    """Host-side gathers for hoisted pulls: extend ``feed`` with the rows."""
+    for table_name, ids_name, out_name in pulls:
+        if ids_name not in feed:
+            raise KeyError(
+                f"host_lookup_table over {table_name!r}: hoisted pull needs "
+                f"ids {ids_name!r} in the feed")
+        ids = np.asarray(feed[ids_name])
+        if ids.ndim > 1 and ids.shape[-1] == 1:
+            ids = ids[..., 0]            # lookup_table squeeze parity
+        feed[out_name] = get_table(table_name).gather(ids)
+    return feed
+
+
+def run_pushes(pushes, fetched):
+    """Apply hoisted pushes: fetched maps grad var name -> host array."""
+    for table_name, ids_name, grad_name, _ in pushes:
+        g = fetched.get(grad_name)
+        if g is None:
+            continue   # lookup output had no gradient this run (eval)
+        get_table(table_name).push(fetched[ids_name],
+                                   np.asarray(g))

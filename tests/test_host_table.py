@@ -226,3 +226,67 @@ def test_row_sharded_lookup_matches_unsharded():
                                atol=1e-6)
     np.testing.assert_allclose(plain_table, shard_table, rtol=1e-4,
                                atol=1e-6)
+
+
+def test_pull_push_hoisting_removes_callbacks():
+    """Round 5: eligible pulls/pushes are hoisted OUT of the compiled
+    program (the reference PS schedule: pull -> device step -> push) so no
+    jax callback remains in the hot path -- required on the axon TPU
+    backend, which has no host-callback support. The rewritten program
+    must hold zero host_lookup_table/host_push_grad ops, the lookup output
+    becomes a feed, and training still updates the table (parity with the
+    in-graph path is pinned by test_host_vs_device_update_parity, which
+    runs through the hoist)."""
+    from paddle_tpu.ops.host_table import hoist_host_pulls
+
+    rng = np.random.RandomState(0)
+    w0 = rng.uniform(-0.1, 0.1, (VOCAB, DIM)).astype(np.float32)
+    fc_w = rng.uniform(-0.1, 0.1, (FIELDS * DIM, 1)).astype(np.float32)
+    name = _fresh("hoist_tbl")
+    main, startup, loss = _build("host", name, w0, fc_w)
+
+    p2, pulls, pushes = hoist_host_pulls(main)
+    assert len(pulls) == 1 and len(pushes) == 1
+    types = [o.type for o in p2.global_block().ops]
+    assert "host_lookup_table" not in types
+    assert "host_push_grad" not in types
+    # original program untouched (the executor caches the rewrite)
+    assert "host_lookup_table" in [o.type for o in main.global_block().ops]
+    out_name = pulls[0][2]
+    assert p2.global_block().var(out_name).is_data
+
+    # executor path end to end: table updates happen via the post-run push
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        before = ht.get_table(name).table.copy()
+        for f in _feeds(3, seed=1):
+            exe.run(main, feed=f, fetch_list=[loss])
+        after = ht.get_table(name).table
+    assert not np.allclose(before, after)
+    assert ht.get_table(name).push_count == 3
+    ht.drop_table(name)
+
+
+def test_pruned_eval_does_not_train_the_table():
+    """use_prune eval (infer_from_dataset semantics) over a hoisted
+    host-table program must not push: the table stays byte-identical
+    (review r5: the hoisted push must respect fetch-graph pruning the way
+    the in-graph push op did)."""
+    rng = np.random.RandomState(2)
+    w0 = rng.uniform(-0.1, 0.1, (VOCAB, DIM)).astype(np.float32)
+    fc_w = rng.uniform(-0.1, 0.1, (FIELDS * DIM, 1)).astype(np.float32)
+    name = _fresh("evalsafe_tbl")
+    main, startup, loss = _build("host", name, w0, fc_w)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        before = ht.get_table(name).table.copy()
+        f = _feeds(1, seed=3)[0]
+        exe.run(main, feed=f, fetch_list=[loss], use_prune=True)
+        np.testing.assert_array_equal(ht.get_table(name).table, before)
+        assert ht.get_table(name).push_count == 0
+        # a real train step does push
+        exe.run(main, feed=f, fetch_list=[loss])
+        assert ht.get_table(name).push_count == 1
+    ht.drop_table(name)
