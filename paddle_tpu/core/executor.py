@@ -211,6 +211,7 @@ class Executor:
         # has none). Sharded (shard_axis) tables and dist-strategy runs
         # keep the in-graph callback path.
         host_pushes = []
+        pending_pulls, pending_pushes = [], []
         if compiled_wrapper is None or not compiled_wrapper.dist_strategy:
             hkey = (id(program), program._version)
             hcache = getattr(self, "_hoist_cache", None)
@@ -223,14 +224,9 @@ class Executor:
                 hcache[hkey] = entry
                 while len(hcache) > self._CACHE_CAP:
                     hcache.pop(next(iter(hcache)))
-            _, hprog, pulls, pushes = entry
-            if pulls:
-                from ..ops import host_table as _ht
+            _, hprog, pending_pulls, pending_pushes = entry
+            if pending_pulls:
                 program = hprog
-                feed = _ht.run_pulls(pulls, feed)
-                # pushes train the table -- never on fetch-pruned (eval)
-                # runs, where the old in-graph push was pruned away too
-                host_pushes = [] if use_prune else pushes
 
         if use_prune and fetch_names:
             # Fetch-graph pruning (reference executor.py _prune_program): run only
@@ -248,6 +244,21 @@ class Executor:
                 while len(self._prune_cache) > self._CACHE_CAP:
                     self._prune_cache.pop(next(iter(self._prune_cache)))
             program = entry[1]
+
+        if pending_pulls:
+            from ..ops import host_table as _ht
+            # only pulls the (possibly fetch-pruned) program still consumes:
+            # an eval over an unrelated branch must neither demand the ids
+            # feed nor pay the host gather
+            consumed = set(fetch_names)
+            for op in program.global_block().ops:
+                for ns in op.inputs.values():
+                    consumed.update(ns)
+            live = [p for p in pending_pulls if p[2] in consumed]
+            feed = _ht.run_pulls(live, feed)
+            # pushes train the table -- never on fetch-pruned (eval) runs,
+            # where the old in-graph push was pruned away too
+            host_pushes = [] if use_prune else pending_pushes
 
         n_user_fetch = len(fetch_names)
         if host_pushes:

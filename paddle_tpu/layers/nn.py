@@ -847,6 +847,19 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
     return pre_act
 
 
+def similarity_focus(input, axis, indexes, name=None):
+    """Reference nn.py:9217 — similarity-focus mask: greedy row/column
+    selection over the 2-D slices at ``indexes`` along ``axis``, broadcast
+    over the axis dim (ops/tail_ops.py mirrors the reference kernel's walk
+    exactly)."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = _out(helper, input.dtype, stop_gradient=True)
+    helper.append_op("similarity_focus", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "indexes": list(indexes)})
+    return _var(helper, out)
+
+
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                excluded_chunk_types=None, seq_length=None):
     """Reference nn.py:2051 — chunk-level precision/recall/F1 for sequence

@@ -593,7 +593,6 @@ def hoist_host_pulls(program):
         return program, [], []
 
     p2 = Program.from_dict(program.to_dict())
-    p2.random_seed = program.random_seed
     b2 = p2.global_block()
     pulls, pushes, drop = [], [], set()
     # single eligibility filter, applied once over the copy (op order is

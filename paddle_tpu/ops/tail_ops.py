@@ -439,3 +439,63 @@ def deformable_conv_v1(ctx, ins):
     ins = dict(ins)
     ins.pop("Mask", None)
     return deformable_conv(ctx, ins)
+
+
+# -- similarity focus ---------------------------------------------------------
+
+@register("similarity_focus", grad=None)
+def similarity_focus(ctx, ins):
+    """Reference similarity_focus_op.h:29: for each batch and each channel
+    in ``indexes`` (along ``axis``), walk the 2-D slice's cells in
+    descending value order and select each cell whose row AND column are
+    both unused (greedy bipartite pick); the output mask is 1 at selected
+    cells, broadcast over the axis dim, OR-ed across indexes.
+
+    The sequential greedy walk is a fixed-length lax.scan over the sorted
+    cell order (once min(rows, cols) cells are picked every later cell is
+    blocked, reproducing the reference's early break). Ties sort by cell
+    index (deterministic; the reference's std::sort leaves tie order
+    unspecified).
+    """
+    import jax
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = int(ctx.attr("axis", 1))
+    indexes = list(ctx.attr("indexes", []))
+    if x.ndim != 4 or axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: X must be 4-D with axis in "
+                         "{1,2,3} (reference contract)")
+    if not indexes:
+        raise ValueError("similarity_focus: Indexes' size can not be 0")
+    perm = [0, axis] + [d for d in (1, 2, 3) if d != axis]
+    xp = jnp.transpose(x, perm)                  # [B, A, R, C]
+    B, A, R, C = xp.shape
+
+    def pick(slice2d):                           # [R, C] -> [R, C] 0/1 mask
+        flat = slice2d.reshape(-1)
+        order = jnp.argsort(-flat)               # stable: ties by index
+
+        def body(carry, idx):
+            rows, cols, mask = carry
+            r = idx // C
+            c = idx % C
+            free = jnp.logical_and(~rows[r], ~cols[c])
+            rows = rows.at[r].set(rows[r] | free)
+            cols = cols.at[c].set(cols[c] | free)
+            mask = mask.at[idx].set(mask[idx] | free)
+            return (rows, cols, mask), None
+
+        init = (jnp.zeros(R, bool), jnp.zeros(C, bool),
+                jnp.zeros(R * C, bool))
+        (_, _, mask), _ = jax.lax.scan(body, init, order)
+        return mask.reshape(R, C)
+
+    mask = jnp.zeros((B, R, C), bool)
+    for index in indexes:
+        if not 0 <= index < A:
+            raise ValueError("similarity_focus: Index exceeds tensor shape "
+                             "limit")
+        mask = mask | jax.vmap(pick)(xp[:, index])
+    out = jnp.broadcast_to(mask[:, None, :, :], (B, A, R, C))
+    inv = [perm.index(d) for d in range(4)]
+    return {"Out": [jnp.transpose(out, inv).astype(x.dtype)]}

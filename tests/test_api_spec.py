@@ -23,7 +23,6 @@ KNOWN_MISSING_LAYERS = {
     "filter_by_instag",
     "prroi_pool",
     "psroi_pool",
-    "similarity_focus",
 }
 
 

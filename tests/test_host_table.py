@@ -290,3 +290,33 @@ def test_pruned_eval_does_not_train_the_table():
         exe.run(main, feed=f, fetch_list=[loss])
         assert ht.get_table(name).push_count == 1
     ht.drop_table(name)
+
+
+def test_pruned_eval_of_unrelated_branch_needs_no_ids():
+    """A pruned eval over a branch that never touches the host embedding
+    must neither require the ids feed nor gather rows (review r5: pulls
+    are filtered against the pruned program)."""
+    rng = np.random.RandomState(4)
+    w0 = rng.uniform(-0.1, 0.1, (VOCAB, DIM)).astype(np.float32)
+    name = _fresh("branch_tbl")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[FIELDS], dtype="int64")
+        z = layers.data("z", shape=[4], dtype="float32")
+        emb = layers.host_embedding(ids, (VOCAB, DIM), name=name,
+                                    initializer=w0)
+        flat = layers.reshape(emb, [-1, FIELDS * DIM])
+        pred = layers.fc(flat, 1)
+        side = layers.mean(layers.square(z))     # independent branch
+        loss = layers.mean(pred) + side
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # no ids in the feed: pruned to `side`, the pull must be skipped
+        sv, = exe.run(main, feed={"z": np.ones((2, 4), np.float32)},
+                      fetch_list=[side], use_prune=True)
+        np.testing.assert_allclose(float(np.asarray(sv).reshape(())), 1.0,
+                                   rtol=1e-6)
+        assert ht.get_table(name).push_count == 0
+    ht.drop_table(name)

@@ -562,3 +562,56 @@ def test_spp_tiny_map_and_unpool_default_size():
                              {"X": [pooled], "Indices": [idx]})["Out"][0]
     assert up.shape == (1, 1, 2, 2)
     np.testing.assert_allclose(np.asarray(up).ravel(), [0, 0, 0, 5.0])
+
+
+def test_similarity_focus_matches_reference_walk():
+    """similarity_focus (reference similarity_focus_op.h): greedy
+    row/column picks over sorted cells, per batch and index, broadcast
+    over the axis dim. Oracle = direct transcription of the C++ walk."""
+    from paddle_tpu.core.registry import get, LowerCtx
+    import jax.numpy as jnp
+
+    def oracle(x, axis, indexes):
+        b = x.shape[0]
+        out = np.zeros_like(x)
+        for i in range(b):
+            for index in indexes:
+                sl = (x[i, index] if axis == 1 else
+                      x[i, :, index] if axis == 2 else x[i, :, :, index])
+                R, C = sl.shape
+                cells = sorted(((sl[r, c], r * C + c)
+                                for r in range(R) for c in range(C)),
+                               key=lambda p: (-p[0], p[1]))
+                ru, cu = [False] * R, [False] * C
+                for v, pos in cells:
+                    r, c = pos // C, pos % C
+                    if ru[r] or cu[c]:
+                        continue
+                    ru[r] = cu[c] = True
+                    if axis == 1:
+                        out[i, :, r, c] = 1
+                    elif axis == 2:
+                        out[i, r, :, c] = 1
+                    else:
+                        out[i, r, c, :] = 1
+        return out
+
+    rng = np.random.RandomState(0)
+    for axis in (1, 2, 3):
+        x = rng.randn(2, 3, 4, 5).astype("float32")
+        got = np.asarray(get("similarity_focus").lower(
+            LowerCtx({"axis": axis, "indexes": [0, 2]}),
+            {"X": [jnp.asarray(x)]})["Out"][0])
+        np.testing.assert_array_equal(got, oracle(x, axis, [0, 2]))
+
+    # layer surface
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.data("xv", [2, 3, 4, 5], "float32",
+                        append_batch_size=False)
+        y = fluid.layers.similarity_focus(xv, axis=1, indexes=[0])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        ov, = exe.run(main, feed={"xv": rng.randn(2, 3, 4, 5)
+                                  .astype("float32")}, fetch_list=[y])
+    assert np.asarray(ov).shape == (2, 3, 4, 5)
