@@ -320,3 +320,41 @@ def test_pruned_eval_of_unrelated_branch_needs_no_ids():
                                    rtol=1e-6)
         assert ht.get_table(name).push_count == 0
     ht.drop_table(name)
+
+
+def test_async_updates_multiple_tables():
+    """Two async host tables in ONE program: each table owns its queue and
+    worker (the async communicator is per-table, reference
+    communicator.h:276 per-var queues); both receive their pushes and both
+    flush cleanly."""
+    rng = np.random.RandomState(5)
+    w0 = rng.uniform(-0.1, 0.1, (VOCAB, DIM)).astype(np.float32)
+    na, nb = _fresh("async_a"), _fresh("async_b")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[FIELDS], dtype="int64")
+        y = layers.data("y", shape=[1], dtype="float32")
+        ea = layers.host_embedding(ids, (VOCAB, DIM), name=na,
+                                   initializer=w0, async_updates=True)
+        eb = layers.host_embedding(ids, (VOCAB, DIM), name=nb,
+                                   initializer=w0, async_updates=True)
+        flat = layers.reshape(layers.elementwise_add(ea, eb),
+                              [-1, FIELDS * DIM])
+        pred = layers.fc(flat, 1, bias_attr=False)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        before_a = ht.get_table(na).table.copy()
+        before_b = ht.get_table(nb).table.copy()
+        for f in _feeds(4, seed=6):
+            exe.run(main, feed=f, fetch_list=[loss])
+        ht.get_table(na).flush()
+        ht.get_table(nb).flush()
+    assert ht.get_table(na).push_count == 4
+    assert ht.get_table(nb).push_count == 4
+    assert not np.allclose(ht.get_table(na).table, before_a)
+    assert not np.allclose(ht.get_table(nb).table, before_b)
+    ht.drop_table(na)
+    ht.drop_table(nb)
