@@ -1,0 +1,102 @@
+"""C serving ABI (reference paddle/fluid/inference/capi): build the
+native/serving_capi.cpp shared library with the in-image toolchain and
+drive it through ctypes -- the same dlopen surface a C serving stack would
+use -- against a model saved by save_inference_model."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "paddle_tpu", "native", "serving_capi.cpp")
+
+
+def _build_lib(tmp_path):
+    import shutil
+    import sysconfig
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    # headers of THE RUNNING interpreter (python3-config could resolve to a
+    # different CPython and dlopen an ABI-mismatched .so into this process)
+    include = sysconfig.get_paths()["include"]
+    so = str(tmp_path / "libpaddle_tpu_capi.so")
+    cmd = ["g++", "-shared", "-fPIC", "-O1", SRC, f"-I{include}", "-o", so]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.fail(f"capi build failed:\n{r.stderr[-2000:]}")
+    return so
+
+
+def test_c_serving_abi_round_trip(tmp_path):
+    # save a small inference model
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 4
+    startup.random_seed = 4
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [8], "float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        y = fluid.layers.fc(h, 3)
+    exe = fluid.Executor()
+    model_dir = str(tmp_path / "model")
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 8).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        fluid.io.save_inference_model(model_dir, ["x"], [y], exe, main)
+    ref = np.asarray(ref)
+
+    so = _build_lib(tmp_path)
+    lib = ctypes.CDLL(so)
+    lib.pd_predictor_create.restype = ctypes.c_void_p
+    lib.pd_predictor_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.pd_predictor_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.pd_predictor_destroy.argtypes = [ctypes.c_void_p]
+    lib.pd_predictor_run.restype = ctypes.c_int
+    lib.pd_predictor_run.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int)]
+    lib.pd_last_error.restype = ctypes.c_char_p
+
+    h = lib.pd_predictor_create(model_dir.encode(), REPO.encode())
+    assert h, lib.pd_last_error().decode()
+    assert lib.pd_predictor_num_outputs(h) == 1
+
+    names = (ctypes.c_char_p * 1)(b"x")
+    data = np.ascontiguousarray(xv)
+    datas = (ctypes.POINTER(ctypes.c_float) * 1)(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    ndims = (ctypes.c_int * 1)(2)
+    shapes = (ctypes.c_longlong * 2)(4, 8)
+    out = np.zeros(64, np.float32)
+    out_shape = (ctypes.c_longlong * 8)()
+    out_ndim = ctypes.c_int(0)
+    rc = lib.pd_predictor_run(
+        h, 1, names, datas, ndims, shapes, 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 64,
+        out_shape, ctypes.byref(out_ndim))
+    assert rc == 0, lib.pd_last_error().decode()
+    shape = tuple(out_shape[i] for i in range(out_ndim.value))
+    assert shape == (4, 3)
+    got = out[:12].reshape(4, 3)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    # buffer-too-small is a clean error, not a crash
+    rc2 = lib.pd_predictor_run(
+        h, 1, names, datas, ndims, shapes, 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 2,
+        out_shape, ctypes.byref(out_ndim))
+    assert rc2 == -2
+    assert b"too small" in lib.pd_last_error()
+
+    lib.pd_predictor_destroy(h)
