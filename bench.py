@@ -329,5 +329,24 @@ def main():
     }), flush=True)
 
 
+def _parse_args(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--emit-metrics", metavar="PATH", default=None,
+                    help="after the run, dump the observability metrics "
+                         "registry (cache hits, compile/run histograms, "
+                         "per-program FLOPs/bytes gauges; MFU too when step "
+                         "timing is synchronous -- PADDLE_TPU_OBS=1 or the "
+                         "benchmark flag) as JSON to PATH -- pairs the "
+                         "BENCH_*.json throughput rounds with telemetry")
+    return ap.parse_args(argv)
+
+
 if __name__ == "__main__":
+    _args = _parse_args()
     main()
+    if _args.emit_metrics:
+        from paddle_tpu.observability import export as _obs_export
+        _obs_export.dump_json(_args.emit_metrics)
+        print(f"[bench] metrics registry written to {_args.emit_metrics}",
+              file=sys.stderr)

@@ -36,6 +36,7 @@ from .compiler import (CompiledProgram, BuildStrategy, ExecutionStrategy,  # noq
 from . import io  # noqa: F401
 from . import contrib  # noqa: F401
 from . import flags  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
 from . import average  # noqa: F401

@@ -23,13 +23,25 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..framework import (Program, Block, Variable, default_main_program)
+from ..observability import journal as _obs_journal
+from ..observability.metrics import REGISTRY as _OBS
 from . import registry
 from .registry import EMPTY_VAR, LowerCtx, stable_salt
+
+
+def _cache_count(kind: str, cache: str, n: int = 1):
+    """hits/misses/evictions counter for one of the executor's caches
+    (compile = the jit/executable LRU, hoist = host-table pull hoisting,
+    prune = fetch-graph pruning)."""
+    _OBS.counter(f"executor_cache_{kind}_total",
+                 f"executor compile-path cache {kind} by cache",
+                 cache=cache).inc(n)
 
 
 class Scope:
@@ -116,6 +128,22 @@ class _CompiledStep:
         # multi-host runs need the target shardings to assemble global arrays
         self.state_shardings = state_shardings or {}
         self.feed_shardings = feed_shardings or {}
+        # AOT-compiled executable (jax .lower().compile()), set by Executor.run
+        # at cache-miss time; backs cost_analysis() and exact compile timing.
+        self.executable = None
+        self.compile_seconds: Optional[float] = None
+
+    def cost_analysis(self):
+        """XLA optimized-HLO cost analysis for this step (raw jax form: a
+        dict, or a one-dict list on older jax). None when the step fell back
+        to the lazy jit path and holds no executable -- normalize with
+        observability.cost.normalize_cost."""
+        if self.executable is None:
+            return None
+        try:
+            return self.executable.cost_analysis()
+        except Exception:
+            return None
 
 
 def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
@@ -187,6 +215,32 @@ class Executor:
         self.place = place
         self._cache: "collections.OrderedDict[Tuple, _CompiledStep]" = \
             collections.OrderedDict()
+        # last compile-key components per Program, for the recompile detector
+        # (entries pin the Program like _cache does, same LRU bound)
+        self._key_parts: Dict[int, Tuple[Program, dict]] = {}
+
+    def _note_compile(self, program: Program, parts: dict):
+        """Record this compile's key components; if the same Program compiled
+        before under different components, count a recompile per changed
+        component and journal which ones changed."""
+        # pop+reinsert = move-to-end, so eviction below is LRU (a hot,
+        # actively recompiling program must not be the first one dropped)
+        prev = self._key_parts.pop(id(program), None)
+        if prev is not None and prev[0] is program:
+            changed = sorted(k for k, v in parts.items()
+                             if prev[1].get(k) != v)
+            if changed:
+                for c in changed:
+                    _OBS.counter("executor_recompiles_total",
+                                 "program recompiles by changed cache-key "
+                                 "component", component=c).inc()
+                _obs_journal.emit({"event": "recompile",
+                                   "program": id(program),
+                                   "version": program._version,
+                                   "changed": changed})
+        self._key_parts[id(program)] = (program, parts)
+        while len(self._key_parts) > self._CACHE_CAP:
+            self._key_parts.pop(next(iter(self._key_parts)))
 
     # -- public API --------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
@@ -219,11 +273,15 @@ class Executor:
                 hcache = self._hoist_cache = {}
             entry = hcache.get(hkey)
             if entry is None or entry[0] is not program:
+                _cache_count("misses", "hoist")
                 from ..ops import host_table as _ht
                 entry = (program,) + _ht.hoist_host_pulls(program)
                 hcache[hkey] = entry
                 while len(hcache) > self._CACHE_CAP:
                     hcache.pop(next(iter(hcache)))
+                    _cache_count("evictions", "hoist")
+            else:
+                _cache_count("hits", "hoist")
             _, hprog, pending_pulls, pending_pushes = entry
             if pending_pulls:
                 program = hprog
@@ -239,10 +297,14 @@ class Executor:
             # the entry retains the source program: after GC, CPython id reuse
             # could otherwise hand a new Program another program's pruned graph
             if entry is None or entry[0] is not program:
+                _cache_count("misses", "prune")
                 entry = (program, program._prune(list(feed), fetch_names))
                 self._prune_cache[pkey] = entry
                 while len(self._prune_cache) > self._CACHE_CAP:
                     self._prune_cache.pop(next(iter(self._prune_cache)))
+                    _cache_count("evictions", "prune")
+            else:
+                _cache_count("hits", "prune")
             program = entry[1]
 
         if pending_pulls:
@@ -308,14 +370,38 @@ class Executor:
                compiled_wrapper.strategy_signature()
                if compiled_wrapper is not None else ())
         compiled = self._cache.get(key)
-        if compiled is None:
+        was_miss = compiled is None
+        if was_miss:
+            _cache_count("misses", "compile")
+            # recompile detector: which cache-key component changed since this
+            # Program last compiled (shape = feed shapes/dtypes, flags = XLA
+            # compiler options, strategy = dist strategy, plus version/
+            # fetches/seed)?
+            self._note_compile(program, {
+                "version": key[1], "shape": key[2], "fetches": key[3],
+                "seed": key[4], "flags": key[5], "strategy": key[6]})
             compiled = self._compile(program, list(feed), fetch_names,
                                      state_in, state_out,
                                      wrapper=compiled_wrapper)
             self._cache[key] = compiled
             while len(self._cache) > self._CACHE_CAP:
-                self._cache.popitem(last=False)
+                old_key, _ = self._cache.popitem(last=False)
+                _cache_count("evictions", "compile")
+                # retire the evicted program's cost gauges with it: the
+                # registry must not grow one series per program compiled over
+                # the life of the process (and a reused CPython id must not
+                # inherit a dead program's numbers). Other feed-shape entries
+                # of the same program share the label -- keep it while any
+                # remain cached.
+                if not any(k[0] == old_key[0] and k[1] == old_key[1]
+                           for k in self._cache):
+                    old_label = f"{old_key[0]}:v{old_key[1]}"
+                    for gname in ("program_flops", "program_bytes_accessed",
+                                  "program_arithmetic_intensity",
+                                  "program_flops_per_sec", "program_mfu"):
+                        _OBS.remove_labeled(gname, program=old_label)
         else:
+            _cache_count("hits", "compile")
             self._cache.move_to_end(key)
 
         mut_names, ro_names = compiled.state_in_names
@@ -368,14 +454,84 @@ class Executor:
         program._rng_run_counter = counter + 1
         rng = np.uint32(counter)
 
+        if was_miss:
+            # AOT-compile now rather than letting jit compile lazily inside
+            # the first call: the executable's cost_analysis() backs the
+            # FLOPs/MFU gauges and the compile time is measured exactly.
+            # Lowering failure (exotic jax version/path) falls back to the
+            # lazy jit dispatch, losing only the telemetry.
+            t0 = time.perf_counter()
+            try:
+                compiled.executable = compiled.fn.lower(
+                    mut_vals, ro_vals, feed_vals, rng).compile()
+            except Exception:
+                compiled.executable = None
+            compiled.compile_seconds = time.perf_counter() - t0
+            _OBS.histogram("executor_compile_seconds",
+                           "trace+XLA-compile wall time per cache miss"
+                           ).observe(compiled.compile_seconds)
+            # timing-independent cost gauges (FLOPs/bytes/intensity) are set
+            # at compile time, unconditionally: they cost one cost_analysis()
+            # per compile and make `bench.py --emit-metrics` carry them
+            # without the journal toggle
+            from ..observability import cost as _obs_cost
+            _obs_cost.update_cost_gauges(
+                compiled, None, f"{id(program)}:v{program._version}")
+
         from .. import flags as _flags
         from .. import profiler as _profiler
+        obs_on = _obs_journal.enabled()
+        step_fn = compiled.executable if compiled.executable is not None \
+            else compiled.fn
         cm = (_profiler.record_event(f"executor_run_v{program._version}")
               if _flags.get_flag("profile_executor") else contextlib.nullcontext())
+        t_run = time.perf_counter()
         with cm:
-            fetches, new_state = compiled.fn(mut_vals, ro_vals, feed_vals, rng)
+            try:
+                fetches, new_state = step_fn(mut_vals, ro_vals, feed_vals, rng)
+            except TypeError:
+                if step_fn is compiled.fn:
+                    raise
+                # aval/pytree drift the AOT executable can't absorb (e.g. a
+                # scope var overwritten host-side with another dtype): jax's
+                # pre-dispatch input check raises TypeError for all three
+                # mismatch classes (shape/dtype/tree), BEFORE launch, so
+                # nothing was donated and no host callback ran; the retrace-
+                # capable jit path handles it. ValueError is deliberately not
+                # caught -- it would be a host-callback error from inside the
+                # step, which must propagate, not silently re-execute.
+                compiled.executable = None
+                fetches, new_state = compiled.fn(mut_vals, ro_vals, feed_vals,
+                                                 rng)
             if _flags.get_flag("benchmark"):
                 jax.block_until_ready(new_state)
+            elif obs_on:
+                # journaled timings are step wall time, not dispatch time
+                jax.block_until_ready((fetches, new_state))
+        run_s = time.perf_counter() - t_run
+        _OBS.histogram("executor_run_seconds",
+                       "Executor.run dispatch/step wall time").observe(run_s)
+        _OBS.counter("executor_runs_total", "Executor.run calls").inc()
+        if obs_on or _flags.get_flag("benchmark"):
+            # both paths block_until_ready above, so run_s is true step wall
+            # time and the derived FLOP/s + MFU gauges are meaningful (the
+            # bare dispatch time of the async path would inflate them)
+            from ..observability import cost as _obs_cost
+            label = f"{id(program)}:v{program._version}"
+            _obs_cost.update_cost_gauges(compiled, run_s, label)
+        if obs_on:
+            _obs_journal.emit({
+                "event": "run", "program": id(program),
+                "version": program._version,
+                "cache": "miss" if was_miss else "hit",
+                "compile_ms": (round(compiled.compile_seconds * 1e3, 3)
+                               if was_miss and compiled.compile_seconds
+                               is not None else None),
+                "run_ms": round(run_s * 1e3, 3),
+                "feed": {n: [list(shape), dtype]
+                         for n, shape, dtype in feed_sig},
+                "fetch": list(fetch_names[:n_user_fetch]),
+            })
         for n, v in new_state.items():
             scope.set_var(n, v)
         if _flags.get_flag("check_nan_inf"):
@@ -398,6 +554,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._key_parts.clear()
 
     @staticmethod
     def _prefetch_batches(batches, depth):

@@ -77,9 +77,13 @@ class Predictor:
     # -- compilation -------------------------------------------------------------------
     def _executable(self, feed: Dict[str, np.ndarray]):
         import jax
+        from .observability.metrics import REGISTRY as _OBS
         sig = tuple((k, tuple(np.shape(feed[k])),
                      str(np.asarray(feed[k]).dtype)) for k in self.feed_names)
         exe = self._compiled.get(sig)
+        _OBS.counter("predictor_executable_cache_total",
+                     "Predictor AOT-executable cache lookups by outcome",
+                     outcome="hit" if exe is not None else "miss").inc()
         if exe is None:
             block = self.program.global_block()
 
@@ -102,15 +106,36 @@ class Predictor:
         """inputs: dict name->array, or list of arrays ordered as feed_names
         (the C++ Run() contract). Returns numpy outputs ordered as
         fetch_names."""
+        import time
+        from .observability import journal as _journal
+        from .observability.metrics import REGISTRY as _OBS
         if not isinstance(inputs, dict):
             inputs = dict(zip(self.feed_names, inputs))
         missing = [n for n in self.feed_names if n not in inputs]
         if missing:
             raise ValueError(f"Predictor.run missing inputs {missing}")
+        t0 = time.perf_counter()
+        n_compiled = len(self._compiled)
         exe = self._executable(inputs)
+        cold = len(self._compiled) > n_compiled  # this request paid a compile
         outs = exe(self._state, {k: np.asarray(inputs[k])
                                  for k in self.feed_names})
-        return [np.asarray(o) for o in outs]
+        outs = [np.asarray(o) for o in outs]   # np.asarray = d2h sync
+        dt = time.perf_counter() - t0
+        # cold/warm are separate series: a first-signature request carries
+        # seconds of XLA compile that would otherwise poison the warm p99
+        _OBS.histogram("predictor_request_seconds",
+                       "Predictor.run end-to-end request latency",
+                       cold="true" if cold else "false").observe(dt)
+        if _journal.enabled():
+            _journal.emit({"event": "predict",
+                           "cold": cold,
+                           "run_ms": round(dt * 1e3, 3),
+                           "feed": {k: [list(np.shape(inputs[k])),
+                                        str(np.asarray(inputs[k]).dtype)]
+                                    for k in self.feed_names},
+                           "fetch": list(self.fetch_names)})
+        return outs
 
     predict = run
 

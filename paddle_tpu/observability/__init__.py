@@ -1,0 +1,23 @@
+"""Runtime observability: metrics registry, cost analysis, run journal.
+
+Reference analog: platform/profiler.{h,cc} + device_tracer + tools/timeline.py
+gave the reference stack its observability surface; here the TPU-native
+reproduction gets the counterpart the whole-program-jit design enables:
+
+- ``metrics``  -- thread-safe Counter/Gauge/Histogram registry (always on,
+  in-memory only); ``export`` renders it as JSON or Prometheus text.
+- ``cost``     -- XLA ``cost_analysis()`` per compiled step -> FLOPs/bytes
+  gauges and achieved MFU against the device peak.
+- ``journal``  -- JSON-lines run journal (one event per ``Executor.run``,
+  plus recompile/predict events), file sink gated on ``PADDLE_TPU_OBS=1``.
+
+Render everything with ``python -m tools.obs_report``.
+"""
+from . import metrics  # noqa: F401
+from . import export  # noqa: F401
+from . import journal  # noqa: F401
+from . import cost  # noqa: F401
+from .metrics import (REGISTRY, MetricsRegistry, Counter, Gauge,  # noqa: F401
+                      Histogram)
+from .export import to_json, to_prometheus, parse_prometheus  # noqa: F401
+from .journal import enabled, emit, recent, read_journal  # noqa: F401

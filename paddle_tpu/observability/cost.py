@@ -1,0 +1,118 @@
+"""XLA cost analysis -> FLOPs/bytes gauges and an achieved-MFU derivation.
+
+The executor's whole-program jit means each compiled step IS one XLA
+executable, so ``Compiled.cost_analysis()`` gives the exact optimized-HLO
+FLOP and HBM-byte counts for a training step -- the per-kernel accounting
+TPP (arxiv 2104.05755) and the EQuARX collectives work lean on. Dividing
+by the measured step wall time yields achieved FLOP/s, and against the
+device's peak (utils/flops.py device table) the achieved MFU.
+
+Peak resolution order: explicit ``peak_flops`` arg > the
+``PADDLE_TPU_OBS_PEAK_FLOPS`` env override (how CPU-backend CI, whose peak
+the device table can't know, still gets a finite MFU) > the device-kind
+table. Unknown peak -> MFU is None and the gauge is not set (never
+fabricated).
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+from .metrics import REGISTRY, MetricsRegistry
+
+
+def normalize_cost(raw) -> Optional[dict]:
+    """jax Compiled.cost_analysis() output -> {"flops", "bytes_accessed",
+    "transcendentals"} floats (0.0 when the backend omits a key).
+
+    Accepts both the modern dict form and the older one-dict-per-computation
+    list form; returns None for empty/unavailable analyses.
+    """
+    if raw is None:
+        return None
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return None
+    return {
+        "flops": float(raw.get("flops", 0.0) or 0.0),
+        "bytes_accessed": float(raw.get("bytes accessed",
+                                        raw.get("bytes_accessed", 0.0)) or 0.0),
+        "transcendentals": float(raw.get("transcendentals", 0.0) or 0.0),
+    }
+
+
+def peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
+    env = os.environ.get("PADDLE_TPU_OBS_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    from ..utils.flops import device_peak_flops
+    return device_peak_flops(device_kind)
+
+
+def achieved_mfu(flops: float, step_seconds: float,
+                 peak: Optional[float] = None,
+                 device_kind: Optional[str] = None) -> Optional[float]:
+    """flops / step_seconds / peak, or None when peak is unknown or the
+    timing is degenerate (<=0 wall time would divide to inf)."""
+    if not step_seconds or step_seconds <= 0 or flops <= 0:
+        return None
+    peak = peak if peak is not None else peak_flops(device_kind)
+    if not peak:
+        return None
+    mfu = flops / step_seconds / peak
+    return mfu if math.isfinite(mfu) else None
+
+
+def update_cost_gauges(compiled_step, step_seconds: float, program: str,
+                       registry: Optional[MetricsRegistry] = None) -> Optional[dict]:
+    """Set per-program cost gauges from a _CompiledStep + measured wall time.
+
+    Gauges (label program=<id:version>): program_flops,
+    program_bytes_accessed, program_flops_per_sec, program_arithmetic_intensity
+    and -- when the device peak is known -- program_mfu. Returns the
+    normalized cost dict (None when the executable/cost analysis is
+    unavailable, e.g. the jit fallback path).
+
+    The analysis result is cached on the step (``_cost_norm``) and the
+    timing-independent gauges are set only on the first call: FLOPs/bytes are
+    compile-time constants, so per-step calls pay one dict lookup plus the
+    timing gauges, not a fresh HLO walk.
+    """
+    registry = registry or REGISTRY
+    ca = getattr(compiled_step, "_cost_norm", False)
+    if ca is False:
+        ca = normalize_cost(compiled_step.cost_analysis())
+        compiled_step._cost_norm = ca
+        if ca is not None:
+            g = registry.gauge
+            g("program_flops",
+              "optimized-HLO FLOPs per step (XLA cost analysis)",
+              program=program).set(ca["flops"])
+            g("program_bytes_accessed", "HBM bytes touched per step",
+              program=program).set(ca["bytes_accessed"])
+            if ca["bytes_accessed"] > 0:
+                g("program_arithmetic_intensity",
+                  "FLOPs per HBM byte (roofline x)",
+                  program=program).set(ca["flops"] / ca["bytes_accessed"])
+    if ca is None:
+        return None
+    g = registry.gauge
+    if step_seconds and step_seconds > 0:
+        g("program_flops_per_sec", "achieved FLOP/s at last measured step",
+          program=program).set(ca["flops"] / step_seconds)
+        mfu = achieved_mfu(ca["flops"], step_seconds)
+        if mfu is not None:
+            g("program_mfu", "achieved FLOP/s over device peak",
+              program=program).set(mfu)
+    return ca

@@ -1,0 +1,103 @@
+"""Structured run journal: one JSON-lines event per notable runtime act.
+
+Two sinks with different costs:
+
+- an in-process ring buffer (bounded deque) that is ALWAYS on -- appending
+  a dict is nanoseconds, and it lets tests and obs_report inspect recent
+  recompile/run events without any environment setup;
+- a JSONL file sink gated on the ``PADDLE_TPU_OBS=1`` env toggle (the
+  FLAGS-style switch documented in README). With the toggle unset nothing
+  is opened or written -- the executor hot path performs no file I/O.
+
+``PADDLE_TPU_OBS_JOURNAL`` overrides the output path (default
+``paddle_tpu_obs.jsonl`` in the CWD). The env is re-read on every emit so
+tests/long-lived processes can flip journaling at runtime.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+DEFAULT_JOURNAL = "paddle_tpu_obs.jsonl"
+_RING_CAP = 1024
+
+_lock = threading.Lock()
+_ring: "collections.deque" = collections.deque(maxlen=_RING_CAP)
+# path -> broken: a journal path that failed to write is warned about once
+# and then skipped -- telemetry must degrade, never abort a training step
+_broken_paths = set()
+
+
+def enabled() -> bool:
+    """Is file journaling on? (PADDLE_TPU_OBS=1/true/yes/on)"""
+    return os.environ.get("PADDLE_TPU_OBS", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def journal_path() -> str:
+    return os.environ.get("PADDLE_TPU_OBS_JOURNAL", DEFAULT_JOURNAL)
+
+
+def emit(event: dict) -> dict:
+    """Record ``event`` (a flat JSON-able dict with an "event" key).
+
+    Stamps ``ts`` (epoch seconds) and ``pid``; appends to the ring buffer
+    always, and to the JSONL file only when journaling is enabled.
+    """
+    ev = dict(event)
+    ev.setdefault("ts", time.time())
+    ev.setdefault("pid", os.getpid())
+    with _lock:
+        _ring.append(ev)
+    if enabled():
+        path = journal_path()
+        if path not in _broken_paths:
+            try:
+                d = os.path.dirname(path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                line = json.dumps(ev, sort_keys=True, default=str)
+                with _lock, open(path, "a") as f:
+                    f.write(line + "\n")
+            except OSError as e:
+                _broken_paths.add(path)
+                import warnings
+                warnings.warn(
+                    f"paddle_tpu journal sink disabled, {path!r} "
+                    f"unwritable: {e}")
+    return ev
+
+
+def recent(n: Optional[int] = None, event: Optional[str] = None) -> List[dict]:
+    """Newest-last slice of the ring buffer, optionally filtered by type."""
+    with _lock:
+        evs = list(_ring)
+    if event is not None:
+        evs = [e for e in evs if e.get("event") == event]
+    return evs[-n:] if n else evs
+
+
+def clear():
+    with _lock:
+        _ring.clear()
+    _broken_paths.clear()
+
+
+def read_journal(path: Optional[str] = None) -> List[dict]:
+    """Parse a JSONL journal file (skipping blank/corrupt tail lines)."""
+    path = path or journal_path()
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn final line from a killed process
+    return out

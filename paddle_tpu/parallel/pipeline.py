@@ -55,6 +55,23 @@ def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x, mesh,
     S = mesh.shape[axis]
     leaves = tree.tree_leaves(x)
     M = leaves[0].shape[0]
+
+    # Schedule-shape telemetry (trace-time: the schedule itself is compiled,
+    # so per-tick runtime counters would just be traced constants). Each
+    # trace contributes its S*(M+S-1) stage spans -- the SectionWorker span
+    # count a host-side profiler would have seen.
+    from ..observability.metrics import REGISTRY as _OBS
+    _OBS.counter("pipeline_traces_total",
+                 "GPipe schedule traces by pipe axis", axis=axis).inc()
+    _OBS.counter("pipeline_stage_spans_total",
+                 "stage executions scheduled (S per tick, M+S-1 ticks)",
+                 axis=axis).inc(S * (M + S - 1))
+    _OBS.gauge("pipeline_schedule_ticks",
+               "ticks (fill+steady+drain) of the last traced schedule",
+               axis=axis).set(M + S - 1)
+    _OBS.gauge("pipeline_bubble_fraction",
+               "(S-1)/(M+S-1), the GPipe fill/drain overhead of the last "
+               "traced schedule", axis=axis).set((S - 1) / (M + S - 1))
     have_consts = consts is not None
     if consts is None:
         consts = ()

@@ -39,6 +39,13 @@ def record_event(name: str):
         dt = time.perf_counter() - t0
         _agg.times[name].append(dt)
         _agg.spans.append((name, t0, dt))
+        # mirror every span into the metrics registry (one histogram per
+        # event label) so the aggregate table and the registry cannot
+        # disagree -- both are fed from this single append site
+        from .observability.metrics import REGISTRY
+        REGISTRY.histogram("profiler_event_seconds",
+                           "RecordEvent span durations by event label",
+                           event=name).observe(dt)
 
 
 class RecordEvent:
@@ -68,16 +75,23 @@ def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
 
 
 def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
-    """Reference DisableProfiler: stop + print the aggregate table."""
+    """Reference DisableProfiler: stop + emit the aggregate table.
+
+    With ``profile_path`` the table goes to that file and is returned --
+    not printed (a profiler(profile_path=...) context must not spam
+    stdout); without a path it prints, as the reference did."""
     import jax
     if getattr(_agg, "trace_dir", None):
         jax.profiler.stop_trace()
+        _agg.trace_dir = None  # capture is finished; a later stop/reset
+        #                        must not touch the (now idle) tracer
     _agg.enabled = False
     table = summary(sorted_key)
     if profile_path:
         with open(profile_path, "w") as f:
             f.write(table)
-    print(table)
+    else:
+        print(table)
     return table
 
 
@@ -111,6 +125,16 @@ def profiler(state: str = "All", sorted_key: str = "total",
 def reset_profiler():
     _agg.times.clear()
     _agg.spans.clear()
+    if getattr(_agg, "trace_dir", None):
+        # a trace is still ACTIVE: stop (discard) it before clearing, else
+        # the tracer is leaked and the next start_profiler(trace_dir=...)
+        # raises "profiler has already been started"
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _agg.trace_dir = None
 
 
 # --------------------------------------------------------------------------

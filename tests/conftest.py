@@ -30,15 +30,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # ---------------------------------------------------------------------------
 # Persistent XLA compilation cache: repeated suite runs (and the many tests
 # that recompile structurally identical programs) skip recompilation.
-# Content-addressed by HLO hash, so stale entries are impossible; delete the
-# directory to reclaim space.
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_compilation_cache")
-try:
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-except Exception:
-    pass  # older jax without the persistent cache: run uncached
+# OPT-IN ONLY (PADDLE_TPU_TEST_COMPILATION_CACHE=1): on this jaxlib CPU
+# build the cache's executable (de)serialization intermittently corrupts
+# the glibc heap ("corrupted double-linked list" SIGABRT/SIGSEGV mid-suite,
+# reproduced ~50% on tests/test_slim.py with the cache on, 0% with it off,
+# fresh or warm cache alike), so correctness wins over warm-rerun speed.
+if os.environ.get("PADDLE_TPU_TEST_COMPILATION_CACHE") == "1":
+    _CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              ".jax_compilation_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax without the persistent cache: run uncached
 
 
 # ---------------------------------------------------------------------------

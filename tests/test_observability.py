@@ -1,0 +1,406 @@
+"""Observability subsystem: registry semantics, executor cache/recompile
+telemetry, XLA cost analysis / MFU, run journal, exposition formats, and the
+obs_report CLI."""
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.observability import cost, export, journal, metrics
+from paddle_tpu.observability.metrics import (REGISTRY, Counter, Gauge,
+                                              Histogram, MetricsRegistry)
+
+
+def _counter_val(name, **labels):
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+    child = fam.children.get(key)
+    return child.value if child is not None else 0.0
+
+
+# ------------------------------------------------------------- registry ----
+
+def test_counter_gauge_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "help text", kind="x")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert reg.counter("c_total", kind="x") is c       # same labels -> child
+    assert reg.counter("c_total", kind="y") is not c   # new labels -> new
+    g = reg.gauge("g")
+    g.set(7)
+    g.dec(2)
+    assert g.value == 5.0
+    with pytest.raises(ValueError):
+        reg.gauge("c_total")  # kind conflict on one name
+
+
+def test_histogram_buckets_and_timer():
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4 and h.sum == pytest.approx(55.55)
+    cum = dict(h.cumulative_buckets())
+    assert cum[0.1] == 1 and cum[1.0] == 2 and cum[10.0] == 3
+    assert cum[math.inf] == 4
+    with h.time():
+        pass
+    assert h.count == 5
+
+
+def test_histogram_bucket_conflict_raises():
+    reg = MetricsRegistry()
+    reg.histogram("b_seconds", buckets=(0.1, 1.0))
+    reg.histogram("b_seconds", buckets=(1.0, 0.1))  # same set, any order: ok
+    reg.histogram("b_seconds")                      # no buckets arg: ok
+    with pytest.raises(ValueError):
+        reg.histogram("b_seconds", buckets=(0.5, 5.0))
+
+
+def test_prometheus_label_escape_roundtrip():
+    reg = MetricsRegistry()
+    for v in ('C:\\new', 'a"b', 'two\nlines', 'tail\\'):
+        reg.counter("esc_total", path=v).inc()
+    parsed = export.parse_prometheus(export.to_prometheus(reg))
+    got = {labels[0][1] for (name, labels) in parsed if name == "esc_total"}
+    assert got == {'C:\\new', 'a"b', 'two\nlines', 'tail\\'}
+
+
+@pytest.mark.smoke
+def test_registry_thread_safety_smoke():
+    reg = MetricsRegistry()
+
+    def work():
+        for i in range(1000):
+            reg.counter("t_total", worker="shared").inc()
+            reg.histogram("t_seconds", worker="shared").observe(i * 1e-4)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("t_total", worker="shared").value == 8000
+    assert reg.histogram("t_seconds", worker="shared").count == 8000
+
+
+# ------------------------------------------------------------ exposition ---
+
+def _sample_registry():
+    reg = MetricsRegistry()
+    reg.counter("hits_total", "cache hits", cache="compile").inc(3)
+    reg.counter("hits_total", cache="prune").inc(1)
+    reg.gauge("mfu", program="1:v0").set(0.375)
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 2.0):
+        h.observe(v)
+    return reg
+
+
+def test_prometheus_roundtrip():
+    reg = _sample_registry()
+    text = export.to_prometheus(reg)
+    parsed = export.parse_prometheus(text)
+    assert parsed[("hits_total", (("cache", "compile"),))] == 3.0
+    assert parsed[("hits_total", (("cache", "prune"),))] == 1.0
+    assert parsed[("mfu", (("program", "1:v0"),))] == 0.375
+    assert parsed[("lat_seconds_count", ())] == 4.0
+    assert parsed[("lat_seconds_sum", ())] == pytest.approx(2.555)
+    assert parsed[("lat_seconds_bucket", (("le", "0.1"),))] == 2.0
+    assert parsed[("lat_seconds_bucket", (("le", "+Inf"),))] == 4.0
+
+
+def test_json_dump_schema(tmp_path):
+    reg = _sample_registry()
+    path = export.dump_json(str(tmp_path / "m.json"), reg)
+    d = json.load(open(path))
+    assert d["format"] == "paddle_tpu_obs_metrics_v1"
+    by_name = {f["name"]: f for f in d["families"]}
+    assert by_name["hits_total"]["type"] == "counter"
+    assert len(by_name["hits_total"]["samples"]) == 2
+    hist = by_name["lat_seconds"]["samples"][0]
+    assert hist["count"] == 4 and hist["buckets"][-1] == ["+Inf", 4]
+
+
+# ------------------------------------------------- executor instrumentation
+
+def _simple_program(shape_dim=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [shape_dim], "float32")
+        y = fluid.layers.fc(x, 4)
+    return main, startup, y
+
+
+@pytest.mark.smoke
+def test_executor_hit_miss_recompile_and_cost():
+    """Acceptance pin: identical runs = one compile (miss then hit); a shape
+    change recompiles and names the changed key component; cost analysis
+    reports nonzero FLOPs and a finite MFU on the CPU backend."""
+    main, startup, y = _simple_program()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    feed = {"x": np.ones((2, 3), "float32")}
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        m0 = _counter_val("executor_cache_misses_total", cache="compile")
+        h0 = _counter_val("executor_cache_hits_total", cache="compile")
+        r0 = _counter_val("executor_recompiles_total", component="shape")
+        journal.clear()
+
+        exe.run(main, feed=feed, fetch_list=[y])
+        exe.run(main, feed=feed, fetch_list=[y])
+        # exactly one compile: miss then hit
+        assert _counter_val("executor_cache_misses_total",
+                            cache="compile") == m0 + 1
+        assert _counter_val("executor_cache_hits_total",
+                            cache="compile") == h0 + 1
+
+        exe.run(main, feed={"x": np.ones((5, 3), "float32")}, fetch_list=[y])
+        assert _counter_val("executor_cache_misses_total",
+                            cache="compile") == m0 + 2
+        assert _counter_val("executor_recompiles_total",
+                            component="shape") == r0 + 1
+
+    # the recompile event names the changed key component
+    evs = journal.recent(event="recompile")
+    assert evs and evs[-1]["changed"] == ["shape"]
+
+    # cost analysis on the compiled step: nonzero FLOPs, finite MFU
+    compiled = next(iter(exe._cache.values()))
+    ca = cost.normalize_cost(compiled.cost_analysis())
+    assert ca is not None and ca["flops"] > 0
+    mfu = cost.achieved_mfu(ca["flops"], step_seconds=0.01, peak=1e12)
+    assert mfu is not None and math.isfinite(mfu) and mfu > 0
+
+
+def test_executor_histograms_and_run_counter():
+    main, startup, y = _simple_program(shape_dim=7)
+    exe = fluid.Executor()
+    runs0 = _counter_val("executor_runs_total")
+    comp_h = REGISTRY.histogram("executor_compile_seconds")
+    run_h = REGISTRY.histogram("executor_run_seconds")
+    c0, r0 = comp_h.count, run_h.count
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 7), "float32")}, fetch_list=[y])
+        exe.run(main, feed={"x": np.ones((2, 7), "float32")}, fetch_list=[y])
+    assert _counter_val("executor_runs_total") == runs0 + 3
+    assert comp_h.count == c0 + 2   # startup + main compile once each
+    assert run_h.count == r0 + 3
+
+
+def test_cost_gauges_set_without_journal_toggle(monkeypatch):
+    """FLOPs/bytes gauges are compile-time and always on -- the
+    `bench.py --emit-metrics` flow gets them without PADDLE_TPU_OBS=1.
+    Timing-derived gauges (flops_per_sec/mfu) stay off: async dispatch
+    time would inflate them."""
+    monkeypatch.delenv("PADDLE_TPU_OBS", raising=False)
+    main, startup, y = _simple_program(shape_dim=11)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 11), "float32")}, fetch_list=[y])
+    label = f"{id(main)}:v{main._version}"
+    key = (("program", label),)
+    fam = REGISTRY.get("program_flops")
+    assert fam is not None and fam.children[key].value > 0
+    fps = REGISTRY.get("program_flops_per_sec")
+    assert fps is None or key not in fps.children
+    # exporters see the gauge through the locked family snapshot
+    assert f'program_flops{{program="{label}"}}' in export.to_prometheus()
+
+
+def test_prune_cache_counters():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [3], "float32")
+        y = fluid.layers.fc(x, 4)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 3), "float32")}
+    m0 = _counter_val("executor_cache_misses_total", cache="prune")
+    h0 = _counter_val("executor_cache_hits_total", cache="prune")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[y], use_prune=True)
+        exe.run(main, feed=feed, fetch_list=[y], use_prune=True)
+    assert _counter_val("executor_cache_misses_total", cache="prune") == m0 + 1
+    assert _counter_val("executor_cache_hits_total", cache="prune") == h0 + 1
+
+
+# --------------------------------------------------------------- journal ---
+
+def test_journal_disabled_writes_no_file(tmp_path, monkeypatch):
+    """Zero-cost when off: no journal file appears without PADDLE_TPU_OBS."""
+    monkeypatch.delenv("PADDLE_TPU_OBS", raising=False)
+    monkeypatch.chdir(tmp_path)
+    assert not journal.enabled()
+    main, startup, y = _simple_program(shape_dim=5)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 5), "float32")}, fetch_list=[y])
+    assert list(tmp_path.iterdir()) == []  # nothing written to CWD
+
+
+def test_journal_event_schema(tmp_path, monkeypatch):
+    jpath = tmp_path / "journal.jsonl"
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", str(jpath))
+    main, startup, y = _simple_program(shape_dim=6)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 6), "float32")}, fetch_list=[y])
+        exe.run(main, feed={"x": np.ones((2, 6), "float32")}, fetch_list=[y])
+    events = journal.read_journal(str(jpath))
+    runs = [e for e in events if e["event"] == "run"]
+    assert len(runs) == 3  # startup + 2 main
+    for e in runs:
+        for field in ("ts", "pid", "program", "version", "cache", "run_ms",
+                      "feed", "fetch"):
+            assert field in e, f"run event missing {field}: {e}"
+    assert runs[1]["cache"] == "miss" and runs[2]["cache"] == "hit"
+    assert runs[1]["compile_ms"] is not None and runs[1]["compile_ms"] > 0
+    assert runs[2]["compile_ms"] is None
+    assert runs[1]["feed"]["x"] == [[2, 6], "float32"]
+    # journaling also feeds the MFU/FLOPs gauges when the peak is known
+    monkeypatch.setenv("PADDLE_TPU_OBS_PEAK_FLOPS", "1e12")
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor()
+        exe2.run(startup)
+        exe2.run(main, feed={"x": np.ones((2, 6), "float32")},
+                 fetch_list=[y])
+    fam = REGISTRY.get("program_mfu")
+    assert fam is not None and any(
+        0 < c.value < math.inf for c in fam.children.values())
+    fam = REGISTRY.get("program_flops")
+    assert fam is not None and any(
+        c.value > 0 for c in fam.children.values())
+
+
+def test_journal_unwritable_path_degrades(monkeypatch, recwarn):
+    """An unwritable journal path must warn once and disable the file sink,
+    never abort the run."""
+    journal.clear()
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL",
+                       "/proc/definitely/not/writable/j.jsonl")
+    e1 = journal.emit({"event": "x"})
+    e2 = journal.emit({"event": "y"})
+    assert e1["event"] == "x" and e2["event"] == "y"   # ring still works
+    warns = [w for w in recwarn.list if "journal sink disabled" in str(w.message)]
+    assert len(warns) == 1                             # warned exactly once
+    assert [e["event"] for e in journal.recent()] == ["x", "y"]
+    journal.clear()                                    # re-arms the sink
+
+
+def test_remove_labeled_gauge():
+    reg = MetricsRegistry()
+    reg.gauge("rm_g", program="a").set(1)
+    reg.gauge("rm_g", program="b").set(2)
+    assert reg.remove_labeled("rm_g", program="a")
+    assert not reg.remove_labeled("rm_g", program="a")   # already gone
+    assert not reg.remove_labeled("no_such_family", x="y")
+    assert [dict(k) for k in reg.get("rm_g").children] == [{"program": "b"}]
+
+
+# -------------------------------------------------------------- profiler ---
+
+def test_record_event_routes_into_registry():
+    import time as _time
+    from paddle_tpu import profiler
+    profiler.start_profiler()
+    h = REGISTRY.histogram("profiler_event_seconds", event="obs_test_span")
+    n0 = h.count
+    with profiler.record_event("obs_test_span"):
+        _time.sleep(0.001)
+    with profiler.record_event("obs_test_span"):
+        pass
+    table = profiler.stop_profiler(profile_path=os.devnull)
+    assert h.count == n0 + 2
+    # the legacy aggregate table and the registry see the same two spans
+    row = [ln for ln in table.splitlines() if "obs_test_span" in ln]
+    assert row and int(row[0].split()[1]) == 2
+    profiler.reset_profiler()
+
+
+def test_stop_profiler_quiet_with_path(tmp_path, capsys):
+    from paddle_tpu import profiler
+    profiler.start_profiler()
+    with profiler.record_event("quiet_span"):
+        pass
+    out = tmp_path / "profile.txt"
+    table = profiler.stop_profiler(profile_path=str(out))
+    assert "quiet_span" in table and "quiet_span" in out.read_text()
+    assert capsys.readouterr().out == ""   # not printed when a path is given
+    profiler.reset_profiler()
+    assert getattr(profiler._agg, "trace_dir", None) is None
+
+
+# -------------------------------------------------------------- pipeline ---
+
+def test_pipeline_trace_counters():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel import pipeline_spmd
+
+    S, M, MB, D = 2, 3, 2, 4
+    mesh = Mesh(np.array(jax.devices()[:S]).reshape(S), ("pp",))
+    W = np.tile(np.eye(D, dtype="float32")[None], (S, 1, 1))
+    x = np.ones((M, MB, D), "float32")
+    t0 = _counter_val("pipeline_traces_total", axis="pp")
+    s0 = _counter_val("pipeline_stage_spans_total", axis="pp")
+    out = pipeline_spmd(lambda p, h: h @ p, jnp.asarray(W),
+                        jnp.asarray(x), mesh, axis="pp")
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+    assert _counter_val("pipeline_traces_total", axis="pp") == t0 + 1
+    assert _counter_val("pipeline_stage_spans_total",
+                        axis="pp") == s0 + S * (M + S - 1)
+    assert REGISTRY.gauge("pipeline_schedule_ticks",
+                          axis="pp").value == M + S - 1
+
+
+# ------------------------------------------------------------ obs_report ---
+
+@pytest.mark.smoke
+def test_obs_report_cli_selftest():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-m", "tools.obs_report",
+                        "--selftest"], cwd=repo, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "selftest: OK" in r.stdout
+
+
+def test_obs_report_renders_real_journal(tmp_path, monkeypatch):
+    jpath = tmp_path / "j.jsonl"
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", str(jpath))
+    main, startup, y = _simple_program(shape_dim=9)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 9), "float32")}, fetch_list=[y])
+    mpath = tmp_path / "m.json"
+    export.dump_json(str(mpath))
+    from tools.obs_report import load_metrics, render_report
+    report = render_report(journal.read_journal(str(jpath)),
+                           load_metrics(str(mpath)))
+    assert "executor runs" in report
+    assert "executor_cache_misses_total" in report
+    assert "hit rate" in report

@@ -1,0 +1,2 @@
+# tools/ is importable so CLIs run as `python -m tools.<name>` from the
+# repo root (tools.obs_report, tools.timeline, ...).

@@ -1,0 +1,253 @@
+"""obs_report: render the run journal + metrics registry as a human report.
+
+The reading end of paddle_tpu/observability/ (the analog of the reference's
+tools/timeline.py, but for metrics/journal instead of trace protos):
+
+    python -m tools.obs_report --journal paddle_tpu_obs.jsonl \
+                               --metrics metrics.json
+    python -m tools.obs_report --selftest      # exercised by the test suite
+
+--metrics accepts the JSON written by ``bench.py --emit-metrics`` /
+``observability.export.dump_json`` OR a Prometheus text exposition dump
+(auto-detected). --live renders this process's in-memory registry instead
+(useful from an interactive session that just ran something).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import List, Optional
+
+
+def _stats(vals: List[float]) -> str:
+    if not vals:
+        return "n=0"
+    vs = sorted(vals)
+    p = lambda q: vs[min(len(vs) - 1, int(q * len(vs)))]
+    return (f"n={len(vs)} mean={sum(vs) / len(vs):.3f} p50={p(0.5):.3f} "
+            f"p95={p(0.95):.3f} max={vs[-1]:.3f}")
+
+
+def _hist_quantile(buckets, q: float) -> Optional[float]:
+    """Upper-bound estimate of quantile q from cumulative [le, count] pairs."""
+    if not buckets or buckets[-1][1] == 0:
+        return None
+    target = q * buckets[-1][1]
+    for le, n in buckets:
+        if n >= target:
+            le = float(le) if not isinstance(le, str) else (
+                math.inf if le == "+Inf" else float(le))
+            return le
+    return None
+
+
+# ---------------------------------------------------------------- journal --
+
+def render_journal(events: List[dict]) -> str:
+    lines = ["== Run journal =="]
+    if not events:
+        lines.append("(no events)")
+        return "\n".join(lines)
+    runs = [e for e in events if e.get("event") == "run"]
+    recompiles = [e for e in events if e.get("event") == "recompile"]
+    predicts = [e for e in events if e.get("event") == "predict"]
+    lines.append(f"{len(events)} events: {len(runs)} executor runs, "
+                 f"{len(recompiles)} recompiles, "
+                 f"{len(predicts)} predictor requests")
+    if runs:
+        hits = sum(1 for e in runs if e.get("cache") == "hit")
+        lines.append(f"compile cache: {hits} hits / {len(runs) - hits} "
+                     f"misses ({hits / len(runs):.1%} hit rate)")
+        lines.append("run_ms: " + _stats(
+            [e["run_ms"] for e in runs if e.get("run_ms") is not None]))
+        compiles = [e["compile_ms"] for e in runs
+                    if e.get("compile_ms") is not None]
+        if compiles:
+            lines.append("compile_ms: " + _stats(compiles))
+        by_prog = {}
+        for e in runs:
+            k = f'{e.get("program")}:v{e.get("version")}'
+            by_prog.setdefault(k, []).append(e)
+        lines.append("per program:")
+        for k, es in sorted(by_prog.items(), key=lambda kv: -len(kv[1])):
+            feeds = {json.dumps(e.get("feed", {}), sort_keys=True)
+                     for e in es}
+            lines.append(f"  {k}: {len(es)} runs, {len(feeds)} feed "
+                         f"signature(s), " +
+                         _stats([e["run_ms"] for e in es
+                                 if e.get("run_ms") is not None]))
+    for e in recompiles:
+        lines.append(f"RECOMPILE program {e.get('program')} "
+                     f"v{e.get('version')}: changed {e.get('changed')}")
+    if predicts:
+        lines.append("predict run_ms: " + _stats(
+            [e["run_ms"] for e in predicts if e.get("run_ms") is not None]))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- metrics --
+
+def render_metrics(snapshot: dict) -> str:
+    lines = ["== Metrics registry =="]
+    fams = snapshot.get("families", [])
+    if not fams:
+        lines.append("(empty)")
+        return "\n".join(lines)
+    for fam in sorted(fams, key=lambda f: (f["type"], f["name"])):
+        for s in fam["samples"]:
+            label = ",".join(f"{k}={v}" for k, v in
+                             sorted(s.get("labels", {}).items()))
+            name = fam["name"] + (f"{{{label}}}" if label else "")
+            if fam["type"] == "histogram":
+                n, tot = s.get("count", 0), s.get("sum", 0.0)
+                mean = tot / n if n else 0.0
+                p50 = _hist_quantile(s.get("buckets", []), 0.5)
+                p99 = _hist_quantile(s.get("buckets", []), 0.99)
+                fmt = lambda v: ("inf" if v is not None and math.isinf(v)
+                                 else f"{v:.4g}" if v is not None else "?")
+                lines.append(f"  [hist]    {name}: n={n} mean={mean:.4g} "
+                             f"p50<={fmt(p50)} p99<={fmt(p99)}")
+            else:
+                lines.append(f"  [{fam['type']:<7}] {name} = "
+                             f"{s.get('value'):g}")
+    return "\n".join(lines)
+
+
+def _prom_to_snapshot(samples: dict) -> dict:
+    """Prometheus parse -> the families/samples shape render_metrics eats.
+    Histogram component samples stay as individual gauges -- good enough
+    for a readable report of a text-format dump."""
+    fams = []
+    for (name, labels), value in sorted(samples.items()):
+        fams.append({"name": name, "type": "gauge", "help": "",
+                     "samples": [{"labels": dict(labels), "value": value}]})
+    return {"families": fams}
+
+
+def load_metrics(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from paddle_tpu.observability.export import parse_prometheus
+        return _prom_to_snapshot(parse_prometheus(text))
+
+
+def render_report(events: Optional[List[dict]],
+                  snapshot: Optional[dict]) -> str:
+    parts = ["# paddle_tpu observability report"]
+    if events is not None:
+        parts.append(render_journal(events))
+    if snapshot is not None:
+        parts.append(render_metrics(snapshot))
+    if events:
+        tail = events[-10:]
+        parts.append("== Journal tail ==")
+        parts.extend(json.dumps(e, sort_keys=True, default=str)
+                     for e in tail)
+    return "\n\n".join(parts)
+
+
+# --------------------------------------------------------------- selftest --
+
+def selftest() -> int:
+    """Build a synthetic registry + journal, render them through the same
+    code path the CLI uses, and assert the report carries the signal. Run
+    from the test suite so this CLI cannot rot."""
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.observability import export as obs_export
+    from paddle_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("executor_cache_hits_total", cache="compile").inc(3)
+    reg.counter("executor_cache_misses_total", cache="compile").inc()
+    reg.counter("executor_recompiles_total", component="shape").inc()
+    reg.gauge("program_mfu", program="1:v0").set(0.42)
+    h = reg.histogram("executor_run_seconds")
+    for v in (0.002, 0.004, 0.008, 0.5):
+        h.observe(v)
+
+    events = [
+        {"event": "run", "program": 1, "version": 0, "cache": "miss",
+         "compile_ms": 812.0, "run_ms": 9.1,
+         "feed": {"x": [[8, 3], "float32"]}, "fetch": ["loss"], "ts": 0.0},
+        {"event": "run", "program": 1, "version": 0, "cache": "hit",
+         "compile_ms": None, "run_ms": 4.2,
+         "feed": {"x": [[8, 3], "float32"]}, "fetch": ["loss"], "ts": 1.0},
+        {"event": "recompile", "program": 1, "version": 0,
+         "changed": ["shape"], "ts": 2.0},
+    ]
+
+    with tempfile.TemporaryDirectory() as td:
+        jpath = os.path.join(td, "journal.jsonl")
+        with open(jpath, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        mpath = os.path.join(td, "metrics.json")
+        obs_export.dump_json(mpath, reg)
+        ppath = os.path.join(td, "metrics.prom")
+        with open(ppath, "w") as f:
+            f.write(obs_export.to_prometheus(reg))
+
+        from paddle_tpu.observability.journal import read_journal
+        report = render_report(read_journal(jpath), load_metrics(mpath))
+        for must in ("2 executor runs", "1 recompiles", "hit rate",
+                     "changed ['shape']", "program_mfu", "0.42",
+                     "executor_run_seconds", "n=4"):
+            assert must in report, f"selftest: {must!r} missing from:\n{report}"
+        # prometheus dump must also load + render
+        prom_report = render_report(None, load_metrics(ppath))
+        assert "executor_cache_hits_total" in prom_report
+    print("obs_report selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.obs_report",
+        description="render paddle_tpu run journal + metrics as a report")
+    ap.add_argument("--journal", default=None,
+                    help="JSONL journal path (default: $PADDLE_TPU_OBS_"
+                         "JOURNAL / paddle_tpu_obs.jsonl when present)")
+    ap.add_argument("--metrics", default=None,
+                    help="metrics dump: bench --emit-metrics JSON or "
+                         "Prometheus text (auto-detected)")
+    ap.add_argument("--live", action="store_true",
+                    help="render this process's in-memory registry")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    events = snapshot = None
+    jpath = args.journal
+    if jpath is None:
+        from paddle_tpu.observability.journal import journal_path
+        jpath = journal_path() if os.path.exists(journal_path()) else None
+    if jpath is not None:
+        from paddle_tpu.observability.journal import read_journal
+        events = read_journal(jpath)
+    if args.metrics:
+        snapshot = load_metrics(args.metrics)
+    elif args.live:
+        from paddle_tpu.observability.export import to_dict
+        snapshot = to_dict()
+    if events is None and snapshot is None:
+        ap.error("nothing to report: pass --journal and/or --metrics "
+                 "(or --live), or run with PADDLE_TPU_OBS=1 first")
+    print(render_report(events, snapshot))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
