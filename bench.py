@@ -339,14 +339,47 @@ def _parse_args(argv=None):
                          "timing is synchronous -- PADDLE_TPU_OBS=1 or the "
                          "benchmark flag) as JSON to PATH -- pairs the "
                          "BENCH_*.json throughput rounds with telemetry")
+    ap.add_argument("--emit-trace", metavar="PATH", default=None,
+                    help="after the run, export the flight-recorder timeline "
+                         "(executor feed-prep/dispatch/fetch phase spans, "
+                         "RecordEvent host spans, device-memory counter "
+                         "track) as Chrome-trace/Perfetto JSON to PATH; "
+                         "arms PADDLE_TPU_OBS=1 if unset -- phase spans "
+                         "only mean anything with synchronous step timing")
     return ap.parse_args(argv)
 
 
 if __name__ == "__main__":
     _args = _parse_args()
+    if _args.emit_trace:
+        # arm the host-span recorder so the exported timeline carries
+        # RecordEvent spans (one per executor run) next to the flight
+        # recorder's feed-prep/dispatch/fetch phases -- and observability
+        # itself: without it (or the benchmark flag) the executor never
+        # blocks on the step, so dispatch spans would be microseconds of
+        # async enqueue and fetch_sync would never record
+        os.environ.setdefault("PADDLE_TPU_OBS", "1")
+        # the obs toggle also opens the journal sink; unless the user chose
+        # a path, keep it next to the trace instead of littering the CWD
+        # with a surprise paddle_tpu_obs.jsonl
+        os.environ.setdefault("PADDLE_TPU_OBS_JOURNAL",
+                              _args.emit_trace + ".journal.jsonl")
+        from paddle_tpu import flags as _flagsmod
+        from paddle_tpu import profiler as _prof
+        _flagsmod.set_flag("profile_executor", True)
+        _prof.start_profiler()
     main()
+    if _args.emit_trace:
+        from paddle_tpu import profiler as _prof
+        _prof.stop_profiler(profile_path=os.devnull)
     if _args.emit_metrics:
         from paddle_tpu.observability import export as _obs_export
         _obs_export.dump_json(_args.emit_metrics)
         print(f"[bench] metrics registry written to {_args.emit_metrics}",
+              file=sys.stderr)
+    if _args.emit_trace:
+        from paddle_tpu.observability import timeline as _obs_timeline
+        _obs_timeline.export_chrome_trace(_args.emit_trace)
+        print(f"[bench] flight-recorder trace written to {_args.emit_trace} "
+              f"(load in chrome://tracing or ui.perfetto.dev)",
               file=sys.stderr)

@@ -24,15 +24,40 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..framework import (Program, Block, Variable, default_main_program)
 from ..observability import journal as _obs_journal
+from ..observability import timeline as _obs_timeline
 from ..observability.metrics import REGISTRY as _OBS
 from . import registry
 from .registry import EMPTY_VAR, LowerCtx, stable_salt
+
+
+_PROGRAM_GAUGES = ("program_flops", "program_bytes_accessed",
+                   "program_arithmetic_intensity", "program_flops_per_sec",
+                   "program_mfu", "program_peak_bytes", "program_temp_bytes",
+                   "program_argument_bytes", "program_output_bytes")
+
+
+def _retire_program_gauges_if_dead(prog_id, version):
+    """Retire a program label's gauges unless some LIVE executor still has
+    a compile-cache entry for it.
+
+    The per-program gauges are process-global, so one executor closing or
+    evicting must not delete telemetry for a label a sibling executor still
+    runs; conversely a reused CPython id must not inherit a dead program's
+    numbers.  Liveness comes from the weak registry of executors
+    (garbage-collected ones drop out on their own, so nothing leaks)."""
+    for exe in list(Executor._instances):
+        if any(k[0] == prog_id and k[1] == version for k in exe._cache):
+            return
+    label = f"{prog_id}:v{version}"
+    for gname in _PROGRAM_GAUGES:
+        _OBS.remove_labeled(gname, program=label)
 
 
 def _cache_count(kind: str, cache: str, n: int = 1):
@@ -210,9 +235,15 @@ class Executor:
 
     _CACHE_CAP = 64  # LRU bound: old Programs/executables must not leak
 
+    # every live executor, weakly: per-program gauge retirement asks "does
+    # any OTHER live executor still cache this label" before deleting
+    # process-global telemetry (GC'd executors fall out automatically)
+    _instances = weakref.WeakSet()
+
     def __init__(self, place=None):
         import collections
         self.place = place
+        Executor._instances.add(self)
         self._cache: "collections.OrderedDict[Tuple, _CompiledStep]" = \
             collections.OrderedDict()
         # last compile-key components per Program, for the recompile detector
@@ -387,23 +418,30 @@ class Executor:
             while len(self._cache) > self._CACHE_CAP:
                 old_key, _ = self._cache.popitem(last=False)
                 _cache_count("evictions", "compile")
-                # retire the evicted program's cost gauges with it: the
-                # registry must not grow one series per program compiled over
-                # the life of the process (and a reused CPython id must not
-                # inherit a dead program's numbers). Other feed-shape entries
-                # of the same program share the label -- keep it while any
-                # remain cached.
-                if not any(k[0] == old_key[0] and k[1] == old_key[1]
-                           for k in self._cache):
-                    old_label = f"{old_key[0]}:v{old_key[1]}"
-                    for gname in ("program_flops", "program_bytes_accessed",
-                                  "program_arithmetic_intensity",
-                                  "program_flops_per_sec", "program_mfu"):
-                        _OBS.remove_labeled(gname, program=old_label)
+                # the evicted entry's step-time window dies with it: windows
+                # are per cache entry, so this is unconditional (unlike the
+                # label-shared gauges below)
+                from ..observability import anomaly as _obs_anomaly
+                _obs_anomaly.DETECTOR.retire(old_key)
+                # retire the evicted program's cost gauges with its last
+                # live cache entry: the registry must not grow one series
+                # per program compiled over the life of the process (and a
+                # reused CPython id must not inherit a dead program's
+                # numbers), but other feed-shape entries -- in this
+                # executor or any other live one -- share the label and
+                # must keep their telemetry.
+                _retire_program_gauges_if_dead(old_key[0], old_key[1])
         else:
             _cache_count("hits", "compile")
             self._cache.move_to_end(key)
 
+        label = f"{id(program)}:v{program._version}"
+        # flight-recorder phases: the per-program run counter doubles as the
+        # step index the spans carry (set before feed-prep so all of one
+        # step's spans agree)
+        step_idx = getattr(program, "_rng_run_counter", 0)
+        _phase = _obs_timeline.phase
+        _t_feed = time.perf_counter()
         mut_names, ro_names = compiled.state_in_names
         mut_vals = {n: scope.find_var(n) for n in mut_names}
         ro_vals = {n: scope.find_var(n) for n in ro_names}
@@ -453,6 +491,9 @@ class Executor:
         counter = getattr(program, "_rng_run_counter", 0)
         program._rng_run_counter = counter + 1
         rng = np.uint32(counter)
+        _obs_timeline.record_span("feed_prep", _t_feed,
+                                  time.perf_counter() - _t_feed,
+                                  step=step_idx, program=label)
 
         if was_miss:
             # AOT-compile now rather than letting jit compile lazily inside
@@ -470,13 +511,20 @@ class Executor:
             _OBS.histogram("executor_compile_seconds",
                            "trace+XLA-compile wall time per cache miss"
                            ).observe(compiled.compile_seconds)
+            _obs_timeline.record_span("compile", t0,
+                                      compiled.compile_seconds,
+                                      step=step_idx, program=label)
             # timing-independent cost gauges (FLOPs/bytes/intensity) are set
             # at compile time, unconditionally: they cost one cost_analysis()
             # per compile and make `bench.py --emit-metrics` carry them
             # without the journal toggle
             from ..observability import cost as _obs_cost
-            _obs_cost.update_cost_gauges(
-                compiled, None, f"{id(program)}:v{program._version}")
+            from ..observability import memory as _obs_memory
+            _obs_cost.update_cost_gauges(compiled, None, label)
+            # same deal for the XLA memory footprint of the step, and one
+            # occupancy sample so every compile marks the memory timeline
+            _obs_memory.update_program_memory_gauges(compiled, label)
+            _obs_memory.sample_device_memory("compile")
 
         from .. import flags as _flags
         from .. import profiler as _profiler
@@ -486,54 +534,91 @@ class Executor:
         cm = (_profiler.record_event(f"executor_run_v{program._version}")
               if _flags.get_flag("profile_executor") else contextlib.nullcontext())
         t_run = time.perf_counter()
+        fallback_retraced = False
         with cm:
-            try:
-                fetches, new_state = step_fn(mut_vals, ro_vals, feed_vals, rng)
-            except TypeError:
-                if step_fn is compiled.fn:
-                    raise
-                # aval/pytree drift the AOT executable can't absorb (e.g. a
-                # scope var overwritten host-side with another dtype): jax's
-                # pre-dispatch input check raises TypeError for all three
-                # mismatch classes (shape/dtype/tree), BEFORE launch, so
-                # nothing was donated and no host callback ran; the retrace-
-                # capable jit path handles it. ValueError is deliberately not
-                # caught -- it would be a host-callback error from inside the
-                # step, which must propagate, not silently re-execute.
-                compiled.executable = None
-                fetches, new_state = compiled.fn(mut_vals, ro_vals, feed_vals,
+            with _phase("dispatch", step=step_idx, program=label):
+                try:
+                    fetches, new_state = step_fn(mut_vals, ro_vals, feed_vals,
                                                  rng)
+                except TypeError:
+                    if step_fn is compiled.fn:
+                        raise
+                    # aval/pytree drift the AOT executable can't absorb (e.g.
+                    # a scope var overwritten host-side with another dtype):
+                    # jax's pre-dispatch input check raises TypeError for all
+                    # three mismatch classes (shape/dtype/tree), BEFORE
+                    # launch, so nothing was donated and no host callback
+                    # ran; the retrace-capable jit path handles it.
+                    # ValueError is deliberately not caught -- it would be a
+                    # host-callback error from inside the step, which must
+                    # propagate, not silently re-execute.
+                    compiled.executable = None
+                    fallback_retraced = True
+                    fetches, new_state = compiled.fn(mut_vals, ro_vals,
+                                                     feed_vals, rng)
             if _flags.get_flag("benchmark"):
-                jax.block_until_ready(new_state)
+                with _phase("fetch_sync", step=step_idx, program=label):
+                    jax.block_until_ready(new_state)
             elif obs_on:
                 # journaled timings are step wall time, not dispatch time
-                jax.block_until_ready((fetches, new_state))
+                with _phase("fetch_sync", step=step_idx, program=label):
+                    jax.block_until_ready((fetches, new_state))
         run_s = time.perf_counter() - t_run
         _OBS.histogram("executor_run_seconds",
                        "Executor.run dispatch/step wall time").observe(run_s)
         _OBS.counter("executor_runs_total", "Executor.run calls").inc()
-        if obs_on or _flags.get_flag("benchmark"):
+        if (not was_miss and not fallback_retraced
+                and (obs_on or _flags.get_flag("benchmark"))):
+            # warm steps only: a compile (cache miss OR the TypeError
+            # fallback's retrace) is an expected outlier and must neither
+            # flag itself nor poison the rolling window.  Synced timing
+            # only: without the block_until_ready above, run_s is bare
+            # async dispatch time -- a device-side regression would be
+            # invisible to the detector and host jitter would false-flag.
+            # Windowed per cache entry (key includes the feed signature):
+            # two shapes of one program may differ legitimately by large
+            # factors and must not share a median.
+            from ..observability import anomaly as _obs_anomaly
+            _obs_anomaly.DETECTOR.observe(label, run_s, key=key)
+        if (obs_on or _flags.get_flag("benchmark")) and not fallback_retraced:
             # both paths block_until_ready above, so run_s is true step wall
             # time and the derived FLOP/s + MFU gauges are meaningful (the
-            # bare dispatch time of the async path would inflate them)
+            # bare dispatch time of the async path would inflate them; a
+            # fallback retrace's run_s contains a whole XLA compile and
+            # would crater them)
             from ..observability import cost as _obs_cost
-            label = f"{id(program)}:v{program._version}"
             _obs_cost.update_cost_gauges(compiled, run_s, label)
         if obs_on:
-            _obs_journal.emit({
-                "event": "run", "program": id(program),
-                "version": program._version,
-                "cache": "miss" if was_miss else "hit",
-                "compile_ms": (round(compiled.compile_seconds * 1e3, 3)
-                               if was_miss and compiled.compile_seconds
-                               is not None else None),
-                "run_ms": round(run_s * 1e3, 3),
-                "feed": {n: [list(shape), dtype]
-                         for n, shape, dtype in feed_sig},
-                "fetch": list(fetch_names[:n_user_fetch]),
-            })
+            self._obs_step = getattr(self, "_obs_step", 0) + 1
+            from ..observability import memory as _obs_memory
+            if self._obs_step % _obs_memory.sample_interval() == 0:
+                _obs_memory.sample_device_memory("interval")
+            with _phase("journal", step=step_idx, program=label):
+                _obs_journal.emit({
+                    "event": "run", "program": id(program),
+                    "version": program._version,
+                    "cache": "miss" if was_miss else "hit",
+                    "compile_ms": (round(compiled.compile_seconds * 1e3, 3)
+                                   if was_miss and compiled.compile_seconds
+                                   is not None else None),
+                    "run_ms": round(run_s * 1e3, 3),
+                    "feed": {n: [list(shape), dtype]
+                             for n, shape, dtype in feed_sig},
+                    "fetch": list(fetch_names[:n_user_fetch]),
+                })
         for n, v in new_state.items():
             scope.set_var(n, v)
+        from ..observability import health as _obs_health
+        hmode = _obs_health.mode()
+        if hmode != "off":
+            # one compiled any-nonfinite reduction over the user fetches
+            # (+ written state when PADDLE_TPU_OBS_HEALTH_STATE=1): a single
+            # packed-bool device->host read, never a per-tensor sync
+            named = list(zip(fetch_names, fetches))[:n_user_fetch]
+            if _obs_health.include_state():
+                named += list(new_state.items())
+            _obs_health.check(named, label, where="executor",
+                              health_mode=hmode)
         if _flags.get_flag("check_nan_inf"):
             bad = [n for n, v in new_state.items()
                    if np.issubdtype(np.asarray(v).dtype, np.floating) and
@@ -553,8 +638,19 @@ class Executor:
         return list(fetches)
 
     def close(self):
+        # same invariant as the eviction path: dropped cache entries take
+        # their anomaly windows with them unconditionally, and per-program
+        # gauges when no live executor caches the label anymore, so a
+        # reused CPython id never inherits a dead program's telemetry and
+        # a still-running sibling executor never loses its own
+        from ..observability import anomaly as _obs_anomaly
+        dropped = list(self._cache)
+        for key in dropped:
+            _obs_anomaly.DETECTOR.retire(key)
         self._cache.clear()
         self._key_parts.clear()
+        for prog_id, version in {(k[0], k[1]) for k in dropped}:
+            _retire_program_gauges_if_dead(prog_id, version)
 
     @staticmethod
     def _prefetch_batches(batches, depth):
@@ -609,7 +705,11 @@ class Executor:
         t.start()
         try:
             while True:
-                item = q.get()
+                # the flight recorder sees host-input stalls as feed_wait
+                # spans: a device-bound epoch shows ~zero wait, a parse-bound
+                # one shows the dataset thread starving the step loop
+                with _obs_timeline.phase("feed_wait", cat="dataset"):
+                    item = q.get()
                 if item is done:
                     break
                 if isinstance(item, BaseException):

@@ -107,7 +107,9 @@ class Predictor:
         (the C++ Run() contract). Returns numpy outputs ordered as
         fetch_names."""
         import time
+        from .observability import health as _health
         from .observability import journal as _journal
+        from .observability import timeline as _timeline
         from .observability.metrics import REGISTRY as _OBS
         if not isinstance(inputs, dict):
             inputs = dict(zip(self.feed_names, inputs))
@@ -118,9 +120,20 @@ class Predictor:
         n_compiled = len(self._compiled)
         exe = self._executable(inputs)
         cold = len(self._compiled) > n_compiled  # this request paid a compile
-        outs = exe(self._state, {k: np.asarray(inputs[k])
-                                 for k in self.feed_names})
-        outs = [np.asarray(o) for o in outs]   # np.asarray = d2h sync
+        with _timeline.phase("feed_prep", cat="predictor"):
+            feed = {k: np.asarray(inputs[k]) for k in self.feed_names}
+        with _timeline.phase("dispatch", cat="predictor"):
+            outs = exe(self._state, feed)
+        with _timeline.phase("fetch_sync", cat="predictor"):
+            outs = [np.asarray(o) for o in outs]   # np.asarray = d2h sync
+        hmode = _health.mode()
+        if hmode != "off":
+            # after fetch_sync: outputs are host numpy, so the scan is pure
+            # host work (health.py's numpy fast path) and the device-compute
+            # wait stays attributed to the fetch_sync span
+            _health.check(list(zip(self.fetch_names, outs)),
+                          f"predictor:{id(self.program)}", where="predictor",
+                          health_mode=hmode)
         dt = time.perf_counter() - t0
         # cold/warm are separate series: a first-signature request carries
         # seconds of XLA compile that would otherwise poison the warm p99

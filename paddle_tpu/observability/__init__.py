@@ -10,6 +10,13 @@ reproduction gets the counterpart the whole-program-jit design enables:
   gauges and achieved MFU against the device peak.
 - ``journal``  -- JSON-lines run journal (one event per ``Executor.run``,
   plus recompile/predict events), file sink gated on ``PADDLE_TPU_OBS=1``.
+- ``timeline`` -- flight-recorder phase spans (feed-prep/dispatch/fetch per
+  step) + the unified Chrome-trace/Perfetto exporter.
+- ``health``   -- NaN/Inf watchdog over fetches/state, one compiled
+  any-nonfinite reduction per step (``PADDLE_TPU_OBS_HEALTH=off|warn|raise``).
+- ``memory``   -- device memory_stats()/live-buffer gauges + per-program
+  ``memory_analysis()`` peak bytes.
+- ``anomaly``  -- rolling median/MAD step-time regression detector.
 
 Render everything with ``python -m tools.obs_report``.
 """
@@ -17,7 +24,13 @@ from . import metrics  # noqa: F401
 from . import export  # noqa: F401
 from . import journal  # noqa: F401
 from . import cost  # noqa: F401
+from . import timeline  # noqa: F401
+from . import health  # noqa: F401
+from . import memory  # noqa: F401
+from . import anomaly  # noqa: F401
 from .metrics import (REGISTRY, MetricsRegistry, Counter, Gauge,  # noqa: F401
                       Histogram)
 from .export import to_json, to_prometheus, parse_prometheus  # noqa: F401
 from .journal import enabled, emit, recent, read_journal  # noqa: F401
+from .timeline import (phase, export_chrome_trace,  # noqa: F401
+                       validate_trace)

@@ -1,0 +1,133 @@
+"""Step-time anomaly detection: rolling median/MAD regression detector.
+
+"Is the run degrading" needs a reference distribution, not a threshold
+constant: step times differ by orders of magnitude across programs and
+batch shapes.  Per program label the detector keeps a rolling window of
+recent step wall times and flags a step that exceeds
+
+    median + k * max(MAD, rel_floor * median, abs_floor)
+
+where MAD is the median absolute deviation (robust to the very outliers
+being hunted), the relative floor keeps a pathologically tight window
+(MAD ~ 0 on a quiet machine) from flagging tiny relative wobble, and the
+absolute floor (1 ms) keeps sub-millisecond-step programs -- where a few
+ms of OS scheduling jitter is normal and harmless -- from alarming at all
+(measured: without it, ~13% of 0.7 ms CPU steps flagged on host noise).  Flagged
+steps increment ``anomaly_total{kind="step_time"}`` and journal a
+``step_time_anomaly`` event carrying the step/median/MAD milliseconds, so
+obs_report and the journal tail show *when* a run started degrading and by
+how much.
+
+Host-side float math over a <=64-entry window (two sorts, ~microseconds);
+always on, no device interaction.  Compile steps are the caller's concern:
+the executor only feeds cache-hit runs, so warmup compiles don't poison
+the window or flag themselves.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+from .metrics import REGISTRY, MetricsRegistry
+
+WINDOW = 64           # rolling sample count per program label
+MIN_SAMPLES = 8       # no verdicts before the window has this many
+THRESHOLD_MADS = 8.0  # k in median + k*MAD
+REL_FLOOR = 0.10      # MAD floor as a fraction of the median
+ABS_FLOOR = 1e-3      # MAD floor in seconds (host-jitter scale)
+# distinct windows tracked (LRU).  Windows are keyed by full compile-cache
+# keys, and one Executor alone holds up to 64 cache entries -- a cap at
+# that size would LRU-thrash every window below MIN_SAMPLES and silently
+# disable detection the moment two executors (or a shape sweep) coexist.
+_LABEL_CAP = 256
+
+
+def _median(sorted_vals):
+    n = len(sorted_vals)
+    mid = n // 2
+    return (sorted_vals[mid] if n % 2 else
+            0.5 * (sorted_vals[mid - 1] + sorted_vals[mid]))
+
+
+class StepTimeAnomalyDetector:
+    """Rolling median/MAD detector over per-label step-time windows."""
+
+    def __init__(self, window: int = WINDOW, min_samples: int = MIN_SAMPLES,
+                 threshold: float = THRESHOLD_MADS,
+                 rel_floor: float = REL_FLOOR, abs_floor: float = ABS_FLOOR,
+                 registry: Optional[MetricsRegistry] = None,
+                 label_cap: int = _LABEL_CAP):
+        self.window = window
+        self.label_cap = label_cap
+        self.min_samples = min_samples
+        self.threshold = threshold
+        self.rel_floor = rel_floor
+        self.abs_floor = abs_floor
+        self.registry = registry or REGISTRY
+        self._lock = threading.Lock()
+        # window key -> recent step seconds (keys are labels or, from the
+        # executor, full compile-cache keys -- any hashable)
+        self._windows: "collections.OrderedDict" = collections.OrderedDict()
+
+    def observe(self, label: str, seconds: float,
+                key=None) -> Optional[dict]:
+        """Feed one step time; returns the anomaly record if flagged.
+
+        ``key`` (default: the label) selects the rolling window -- the
+        executor passes its full compile-cache key so two feed signatures
+        of one program, whose legitimate step times can differ by large
+        factors, never share a median.  The journaled record still carries
+        the human-readable ``label``.
+
+        The verdict is computed against the window *before* this step
+        enters it, so one slow step cannot mask itself; the sample is
+        appended either way (a persistent regression becomes the new
+        normal after ~window/2 steps rather than alerting forever).
+        """
+        wkey = label if key is None else key
+        with self._lock:
+            win = self._windows.pop(wkey, None)
+            if win is None:
+                win = collections.deque(maxlen=self.window)
+            self._windows[wkey] = win         # move-to-end: LRU
+            while len(self._windows) > self.label_cap:
+                self._windows.popitem(last=False)
+            vals = sorted(win)
+            win.append(seconds)
+        if len(vals) < self.min_samples:
+            return None
+        med = _median(vals)
+        mad = _median(sorted(abs(v - med) for v in vals))
+        limit = med + self.threshold * max(mad, self.rel_floor * med,
+                                           self.abs_floor)
+        if seconds <= limit:
+            return None
+        record = {
+            "event": "step_time_anomaly", "program": label,
+            "step_ms": round(seconds * 1e3, 3),
+            "median_ms": round(med * 1e3, 3),
+            "mad_ms": round(mad * 1e3, 3),
+            "limit_ms": round(limit * 1e3, 3),
+            "n_window": len(vals),
+        }
+        self.registry.counter(
+            "anomaly_total", "anomalous observations by detector kind",
+            kind="step_time").inc()
+        from . import journal as _journal
+        _journal.emit(record)
+        return record
+
+    def retire(self, key):
+        """Drop a window (compile-cache eviction): a reused CPython id must
+        not be judged against a dead program's step times."""
+        with self._lock:
+            self._windows.pop(key, None)
+
+    def reset(self):
+        with self._lock:
+            self._windows.clear()
+
+
+#: process-wide detector the executor feeds.
+DETECTOR = StepTimeAnomalyDetector()

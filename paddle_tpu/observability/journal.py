@@ -32,10 +32,19 @@ _ring: "collections.deque" = collections.deque(maxlen=_RING_CAP)
 _broken_paths = set()
 
 
+#: the one truthy-spelling set for every PADDLE_TPU_OBS* toggle -- health
+#: and sibling modules reuse it so no toggle accepts a spelling another
+#: rejects
+TRUTHY = ("1", "true", "yes", "on")
+
+
+def env_truthy(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in TRUTHY
+
+
 def enabled() -> bool:
     """Is file journaling on? (PADDLE_TPU_OBS=1/true/yes/on)"""
-    return os.environ.get("PADDLE_TPU_OBS", "").lower() in (
-        "1", "true", "yes", "on")
+    return env_truthy("PADDLE_TPU_OBS")
 
 
 def journal_path() -> str:

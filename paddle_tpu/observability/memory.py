@@ -1,0 +1,143 @@
+"""Device-memory telemetry: per-device gauges + per-program peak bytes.
+
+Two sources, both free of device synchronization:
+
+- runtime occupancy: ``jax.local_devices()[i].memory_stats()`` (TPU/GPU
+  PJRT backends report bytes_in_use / peak_bytes_in_use); the CPU test
+  backend returns None, so a ``jax.live_arrays()`` fallback sums the
+  committed bytes per device -- coarser (process-level, no allocator
+  overhead) but it keeps the gauges meaningful in CI.  Samples land in
+  ``device_memory_bytes_in_use`` / ``device_memory_peak_bytes`` gauges, the
+  ``memory_samples_total`` counter, and a flight-recorder counter track so
+  the exported Chrome trace carries a memory-over-time line.
+- compile-time footprint: each compiled step's
+  ``executable.memory_analysis()`` -> ``program_peak_bytes`` (+ the
+  argument/output/temp decomposition) per program label, the XLA-exact
+  answer to "does this step fit".
+
+The executor samples at compile time and then every K steps
+(``PADDLE_TPU_OBS_MEM_INTERVAL``, default 10) while ``PADDLE_TPU_OBS`` is
+on; with it off the per-step path does nothing.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .metrics import REGISTRY, MetricsRegistry
+
+DEFAULT_INTERVAL = 10
+
+
+def sample_interval() -> int:
+    raw = os.environ.get("PADDLE_TPU_OBS_MEM_INTERVAL", "")
+    try:
+        k = int(raw) if raw else DEFAULT_INTERVAL
+    except ValueError:
+        k = DEFAULT_INTERVAL
+    return max(1, k)
+
+
+def _live_bytes_by_device() -> Dict[str, int]:
+    """Fallback accounting: committed live jax.Array bytes per device."""
+    import jax
+    out: Dict[str, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            nbytes = arr.nbytes
+            devs = arr.devices()
+        except Exception:
+            continue
+        for d in devs:
+            key = f"{d.platform}:{d.id}"
+            out[key] = out.get(key, 0) + nbytes // max(1, len(devs))
+    return out
+
+
+def sample_device_memory(reason: str = "step",
+                         registry: Optional[MetricsRegistry] = None,
+                         ) -> Dict[str, Dict[str, float]]:
+    """Take one memory sample; set gauges + counter track; return the
+    {device: {bytes_in_use, peak_bytes}} snapshot (tests/obs_report)."""
+    import jax
+
+    registry = registry or REGISTRY
+    snapshot: Dict[str, Dict[str, float]] = {}
+    fallback = None
+    for d in jax.local_devices():
+        key = f"{d.platform}:{d.id}"
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            in_use = float(stats.get("bytes_in_use", 0.0))
+            peak = float(stats.get("peak_bytes_in_use", in_use))
+        else:
+            if fallback is None:
+                fallback = _live_bytes_by_device()
+            in_use = float(fallback.get(key, 0))
+            # no allocator high-water mark without memory_stats(): track the
+            # max this process has observed so the gauge is still monotone
+            g = registry.gauge("device_memory_peak_bytes",
+                               "peak device bytes (allocator high-water "
+                               "mark, or max observed sample)", device=key)
+            peak = max(g.value, in_use)
+        snapshot[key] = {"bytes_in_use": in_use, "peak_bytes": peak}
+        registry.gauge("device_memory_bytes_in_use",
+                       "device bytes in use at last sample",
+                       device=key).set(in_use)
+        registry.gauge("device_memory_peak_bytes",
+                       "peak device bytes (allocator high-water mark, or "
+                       "max observed sample)", device=key).set(peak)
+    registry.counter("memory_samples_total",
+                     "device-memory telemetry samples by reason",
+                     reason=reason).inc()
+    from . import timeline as _timeline
+    _timeline.counter_sample(
+        "device_memory_bytes",
+        {k: v["bytes_in_use"] for k, v in snapshot.items()})
+    return snapshot
+
+
+def update_program_memory_gauges(compiled_step, program: str,
+                                 registry: Optional[MetricsRegistry] = None,
+                                 ) -> Optional[Dict[str, float]]:
+    """Set per-program footprint gauges from the executable's
+    ``memory_analysis()``.  Returns the byte decomposition, or None when the
+    step holds no executable (lazy-jit fallback) or the backend lacks the
+    analysis."""
+    registry = registry or REGISTRY
+    exe = getattr(compiled_step, "executable", None)
+    if exe is None:
+        return None
+    try:
+        ma = exe.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    parts = {
+        "argument_bytes": float(getattr(ma, "argument_size_in_bytes", 0) or 0),
+        "output_bytes": float(getattr(ma, "output_size_in_bytes", 0) or 0),
+        "temp_bytes": float(getattr(ma, "temp_size_in_bytes", 0) or 0),
+        "alias_bytes": float(getattr(ma, "alias_size_in_bytes", 0) or 0),
+        "code_bytes": float(getattr(ma, "generated_code_size_in_bytes", 0)
+                            or 0),
+    }
+    # aliased (donated) buffers are counted inside argument_bytes and reused
+    # for outputs -- subtract so peak is not double-counted
+    parts["peak_bytes"] = max(
+        0.0, parts["argument_bytes"] + parts["output_bytes"] +
+        parts["temp_bytes"] - parts["alias_bytes"])
+    g = registry.gauge
+    g("program_peak_bytes", "XLA memory_analysis arg+out+temp-alias bytes "
+      "for the compiled step", program=program).set(parts["peak_bytes"])
+    g("program_temp_bytes", "XLA scratch bytes for the compiled step",
+      program=program).set(parts["temp_bytes"])
+    g("program_argument_bytes", "input (incl. donated state) bytes",
+      program=program).set(parts["argument_bytes"])
+    g("program_output_bytes", "output bytes", program=program).set(
+        parts["output_bytes"])
+    return parts

@@ -1,0 +1,274 @@
+"""Flight-recorder timeline: per-step phase spans -> one Chrome trace.
+
+The reference framework answered "where did step N's time go" with
+platform/profiler RecordEvent push/pop plus tools/timeline.py (Chrome
+trace).  Here every hot path (Executor.run feed-prep/dispatch/fetch,
+train_from_dataset batch waits, Predictor.run, the GPipe schedule trace)
+records ``phase(...)`` spans into a bounded in-process ring -- an append is
+two ``perf_counter`` calls and a deque push, cheap enough to stay always
+on, like the journal ring.  Nothing is written to disk until
+``export_chrome_trace`` is called (``bench.py --emit-trace``), so with
+``PADDLE_TPU_OBS`` unset the hot path still performs zero file I/O.
+
+The exporter unifies three sources onto one trace-event-format timeline
+(all clocked by ``time.perf_counter``, so spans interleave correctly):
+
+- flight-recorder phase spans (this module's ring),
+- legacy ``profiler.record_event`` host spans (``profiler._agg.spans``),
+- counter samples (device-memory telemetry from ``observability.memory``)
+  as Chrome counter ("C") tracks,
+
+and can additionally splice in the XLA xplane capture that
+``profiler.export_chrome_tracing`` decompresses, giving device op events
+next to the host phases.  Load the output in chrome://tracing or
+https://ui.perfetto.dev.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+# pids for the synthesized process tracks; chosen above the xplane capture's
+# pid range and distinct from profiler._host_span_events' 90000 default
+PID_PHASES = 90001
+PID_COUNTERS = 90002
+
+_SPAN_CAP = 65536
+_lock = threading.Lock()
+# (name, category, t0_seconds, dur_seconds, args or None)
+_spans: "collections.deque" = collections.deque(maxlen=_SPAN_CAP)
+# (track_name, t_seconds, {series: value})
+_counters: "collections.deque" = collections.deque(maxlen=_SPAN_CAP)
+
+
+@contextlib.contextmanager
+def phase(name: str, cat: str = "executor", **args):
+    """Record one flight-recorder span around the body.
+
+    Also mirrors the duration into the ``phase_seconds`` histogram (labels
+    phase=name) so obs_report can summarize phases without a trace export.
+    """
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_span(name, t0, time.perf_counter() - t0, cat=cat, **args)
+
+
+def record_span(name: str, t0: float, dur: float, cat: str = "executor",
+                **args):
+    """Append an already-timed span (t0 from time.perf_counter); mirrors
+    into the ``phase_seconds`` histogram.  Labeled by phase AND category:
+    executor and Predictor both record dispatch/feed_prep/fetch_sync and
+    their durations differ by orders of magnitude -- one merged series
+    would describe neither workload."""
+    with _lock:
+        # recording thread rides along: concurrent Predictor.run spans must
+        # land on separate trace tracks, not garble one tid-0 line
+        _spans.append((name, cat, t0, dur, args or None,
+                       threading.get_ident()))
+    from .metrics import REGISTRY
+    REGISTRY.histogram("phase_seconds",
+                       "flight-recorder phase durations by phase and "
+                       "category", phase=name, cat=cat).observe(dur)
+
+
+def counter_sample(track: str, values: Dict[str, float],
+                   t: Optional[float] = None):
+    """Record one sample of a counter track (e.g. device memory bytes)."""
+    with _lock:
+        _counters.append((track, time.perf_counter() if t is None else t,
+                          dict(values)))
+
+
+def spans(name: Optional[str] = None) -> List[tuple]:
+    with _lock:
+        out = list(_spans)
+    if name is not None:
+        out = [s for s in out if s[0] == name]
+    return out
+
+
+def counters(track: Optional[str] = None) -> List[tuple]:
+    with _lock:
+        out = list(_counters)
+    if track is not None:
+        out = [c for c in out if c[0] == track]
+    return out
+
+
+def clear():
+    with _lock:
+        _spans.clear()
+        _counters.clear()
+
+
+def _trace_events(host_pid: int = PID_PHASES) -> List[dict]:
+    """The ring contents as trace-event dicts (ts/dur in microseconds)."""
+    events: List[dict] = [
+        {"ph": "M", "pid": host_pid, "name": "process_name",
+         "args": {"name": "paddle_tpu flight recorder (phases)"}},
+        {"ph": "M", "pid": PID_COUNTERS, "name": "process_name",
+         "args": {"name": "paddle_tpu telemetry (counters)"}},
+    ]
+    with _lock:
+        span_list = list(_spans)
+        counter_list = list(_counters)
+    tid_map = {t: i for i, t in enumerate(
+        sorted({s[5] for s in span_list if len(s) > 5}))}
+    for s in span_list:
+        name, cat, t0, dur, args = s[:5]
+        # small stable tids (enumerate recording threads), not raw idents
+        tid = tid_map[s[5]] if len(s) > 5 else 0
+        ev = {"ph": "X", "pid": host_pid, "tid": tid, "name": name,
+              "cat": cat, "ts": max(t0, 0.0) * 1e6, "dur": max(dur, 0.0) * 1e6}
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    for track, t, values in counter_list:
+        events.append({"ph": "C", "pid": PID_COUNTERS, "name": track,
+                       "ts": max(t, 0.0) * 1e6, "args": values})
+    return events
+
+
+def _shift_onto_xplane(perf_events: List[dict], xplane_events: List[dict],
+                       trace_dir: Optional[str] = None) -> List[dict]:
+    """Re-clock perf_counter-domain events onto the xplane trace's epoch.
+
+    The two sources tick different clocks: our spans carry raw
+    ``time.perf_counter()*1e6`` (epoch ~system boot) while the xplane
+    chrome trace is normalized to its own capture start -- naively merged,
+    every device event lands hours away from the host phases.  Anchor:
+    ``profiler._agg.trace_anchor`` (perf_counter at ``start_trace``, keyed
+    by the capture's trace_dir so a stale anchor from an earlier capture
+    never re-clocks a different one) maps to the xplane events' minimum ts;
+    without a matching one (capture not started through our profiler) fall
+    back to aligning the two minima.  Spans that began before the capture
+    clamp to ts 0.
+    """
+    base = min((float(e.get("ts", 0.0)) for e in xplane_events
+                if e.get("ph") != "M"), default=None)
+    if base is None:
+        return perf_events
+    from .. import profiler as _profiler
+    anchor = getattr(_profiler._agg, "trace_anchor", None)
+    # abspath-normalized compare: './tb' vs 'tb' vs 'tb/' is the same
+    # capture and must not silently discard the anchor
+    t0 = (anchor[1] if anchor is not None and anchor[0] is not None
+          and trace_dir is not None
+          and os.path.abspath(anchor[0]) == os.path.abspath(trace_dir)
+          else None)
+    if t0 is None:
+        t0 = min((float(e.get("ts", 0.0)) for e in perf_events
+                  if e.get("ph") != "M"), default=None)
+        if t0 is None:
+            return perf_events
+    delta = base - t0
+    out = []
+    for e in perf_events:
+        if e.get("ph") != "M":
+            e = dict(e)
+            e["ts"] = max(float(e.get("ts", 0.0)) + delta, 0.0)
+        out.append(e)
+    return out
+
+
+def export_chrome_trace(output_path: str = "timeline.json",
+                        trace_dir: Optional[str] = None,
+                        include_profiler: bool = True) -> str:
+    """Write the unified Chrome-trace/Perfetto JSON timeline.
+
+    Merges the flight-recorder phase spans and counter tracks with the
+    legacy profiler RecordEvent spans (same perf_counter clock -> same
+    timeline), plus -- when ``trace_dir`` points at a finished
+    ``profiler(trace_dir=...)`` capture -- the XLA xplane chrome trace's
+    device events.  Returns ``output_path``.
+    """
+    from .. import profiler as _profiler
+    events = _trace_events()
+    src = (_profiler._find_xplane_chrome_trace(trace_dir)
+           if trace_dir is not None else None)
+    if trace_dir is not None and src is None:
+        # same contract as profiler.export_chrome_tracing: a trace_dir with
+        # no capture is a caller error (typo, capture never flushed) -- a
+        # silent host-only file would masquerade as the device timeline
+        raise FileNotFoundError(
+            f"no xplane chrome trace (*.trace.json.gz) under {trace_dir!r};"
+            f" pass the directory given to profiler(trace_dir=...) after "
+            f"the capture stopped, or trace_dir=None for a host-only "
+            f"timeline")
+    if src is not None:
+        # RecordEvent spans are NOT synthesized here: they already ride the
+        # xplane capture via TraceAnnotation -- re-synthesizing would
+        # double-count every span in obs_report.
+        return splice_into_xplane(src, events, trace_dir, output_path)
+    if include_profiler:
+        host = _profiler._host_span_events()
+        # skip the metadata record when there are no spans behind it
+        if len(host) > 1:
+            events.extend(host)
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    trace["traceEvents"].sort(key=lambda e: (e.get("ph") != "M",
+                                             e.get("ts", 0.0)))
+    with open(output_path, "w") as f:
+        json.dump(trace, f)
+    return output_path
+
+
+def splice_into_xplane(src: str, perf_events: List[dict],
+                       trace_dir: Optional[str], output_path: str) -> str:
+    """Merge perf_counter-domain events into the xplane chrome trace at
+    ``src`` (gzip JSON): re-clock them onto the capture's epoch, keep the
+    xplane file's own top-level keys (displayTimeUnit, metadata), sort,
+    write.  The single splice implementation behind both
+    ``export_chrome_trace(trace_dir=...)`` and
+    ``profiler.export_chrome_tracing``."""
+    import gzip
+    with gzip.open(src, "rt") as f:
+        trace = json.load(f)
+    trace.setdefault("traceEvents", [])
+    # the two sources tick different clocks -- re-anchor ours onto the
+    # xplane epoch before they share a file
+    trace["traceEvents"].extend(
+        _shift_onto_xplane(perf_events, trace["traceEvents"], trace_dir))
+    trace["traceEvents"].sort(key=lambda e: (e.get("ph") != "M",
+                                             e.get("ts", 0.0)))
+    with open(output_path, "w") as f:
+        json.dump(trace, f)
+    return output_path
+
+
+def validate_trace(path: str) -> List[dict]:
+    """Load ``path`` and assert it is well-formed trace-event JSON with
+    monotone-sortable, non-negative ts/dur.  Returns the event list (tests
+    and obs_report use this instead of re-implementing the checks)."""
+    with open(path) as f:
+        trace = json.load(f)
+    if isinstance(trace, dict):
+        events = trace.get("traceEvents")
+        if events is None:
+            raise ValueError(
+                f"{path}: no 'traceEvents' key -- not a Chrome trace "
+                f"(a metrics dump? pass this file to --metrics instead)")
+    else:
+        events = trace
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    last_ts = 0.0
+    for e in events:
+        if "ph" not in e:
+            raise ValueError(f"{path}: event missing 'ph': {e}")
+        if e["ph"] == "M":
+            continue
+        ts = float(e.get("ts", 0.0))
+        if ts < 0 or float(e.get("dur", 0.0)) < 0:
+            raise ValueError(f"{path}: negative ts/dur: {e}")
+        if ts < last_ts:
+            raise ValueError(f"{path}: events not sorted by ts at {e}")
+        last_ts = ts
+    return events

@@ -118,7 +118,8 @@ def collective_permute(ctx, ins):
     name = _axis(ctx)
     if not _axis_bound(name):
         return {"Out": [x]}
-    n = jax.lax.axis_size(name)
+    # static axis size via psum-of-1 (jax.lax.axis_size was removed)
+    n = jax.lax.psum(1, name)
     off = ctx.attr("offset", 1)
     perm = [(i, (i + off) % n) for i in range(n)]
     return {"Out": [jax.lax.ppermute(x, name, perm)]}

@@ -130,4 +130,10 @@ def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x, mesh,
         fn = shard_map(per_device, mesh=mesh,
                        in_specs=(pspec, xspec, cspec), out_specs=xspec,
                        check_rep=False)
-    return fn(stacked_params, x, consts)
+    # one flight-recorder span per schedule trace+dispatch: the compiled
+    # schedule has no per-tick host visibility, so the span carries the
+    # shape (S stages, M microbatches, M+S-1 ticks) instead
+    from ..observability import timeline as _timeline
+    with _timeline.phase("pipeline_schedule", cat="pipeline", axis=axis,
+                         stages=S, microbatches=M, ticks=M + S - 1):
+        return fn(stacked_params, x, consts)

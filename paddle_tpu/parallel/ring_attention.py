@@ -44,7 +44,9 @@ def _ring_local(q, k, v, bias, seed, scale, dropout, causal, axis,
     import jax.numpy as jnp
 
     key = jax.random.PRNGKey(seed[0])
-    n = jax.lax.axis_size(axis)
+    # static axis size: psum of a Python int folds to size*1 at trace time
+    # (jax.lax.axis_size was removed from current JAX)
+    n = jax.lax.psum(1, axis)
     my = jax.lax.axis_index(axis)
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -54,7 +56,13 @@ def _ring_local(q, k, v, bias, seed, scale, dropout, causal, axis,
         try:
             return jax.lax.pcast(x, vary_axes, to="varying")
         except AttributeError:
+            pass
+        try:
             return jax.lax.pvary(x, vary_axes)
+        except AttributeError:
+            # pre-vma jax (< 0.6): no varying-type system, carries need no
+            # cast -- identity is correct
+            return x
 
     m0 = varying(jnp.full((B, H, Sq, 1), -jnp.inf, jnp.float32))
     l0 = varying(jnp.zeros((B, H, Sq, 1), jnp.float32))

@@ -14,13 +14,14 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from collections import defaultdict
 from typing import Dict, Optional
 
 
 class _Agg(threading.local):
     def __init__(self):
-        self.times: Dict[str, list] = defaultdict(list)
+        # plain dict, NOT defaultdict: a read (summary/report on a name that
+        # never fired) must not materialize an empty row as a side effect
+        self.times: Dict[str, list] = {}
         self.spans: list = []   # (name, start_s, dur_s) for timeline export
         self.enabled = False
 
@@ -37,7 +38,7 @@ def record_event(name: str):
         yield
     if _agg.enabled:
         dt = time.perf_counter() - t0
-        _agg.times[name].append(dt)
+        _agg.times.setdefault(name, []).append(dt)
         _agg.spans.append((name, t0, dt))
         # mirror every span into the metrics registry (one histogram per
         # event label) so the aggregate table and the registry cannot
@@ -67,9 +68,19 @@ def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
     import jax
     _agg.enabled = True
     _agg.times.clear()
+    # spans too: they feed every timeline export now, and a second session
+    # must not carry the previous one's RecordEvent spans (pre-capture
+    # spans would delta-shift negative and pile up clamped at ts 0)
+    _agg.spans.clear()
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
         _agg.trace_dir = trace_dir
+        # capture start on the host perf_counter clock, keyed by trace_dir:
+        # the xplane chrome trace uses its own ts epoch, and this anchor is
+        # what lets the flight-recorder spans be shifted onto it at export
+        # time (kept past stop_profiler -- export happens after stop -- but
+        # only ever applied to THIS capture's directory)
+        _agg.trace_anchor = (trace_dir, time.perf_counter() * 1e6)
     else:
         _agg.trace_dir = None
 
@@ -96,8 +107,13 @@ def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None)
 
 
 def summary(sorted_key: str = "total") -> str:
+    """Aggregate table; on an empty/never-enabled aggregate, a well-formed
+    header + explicit empty marker (never a KeyError or a defaultdict
+    side-effect row)."""
     rows = []
     for name, ts in _agg.times.items():
+        if not ts:
+            continue
         rows.append((name, len(ts), sum(ts), sum(ts) / len(ts), min(ts),
                      max(ts)))
     key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
@@ -108,6 +124,8 @@ def summary(sorted_key: str = "total") -> str:
     for r in rows:
         lines.append(f"{r[0]:<40}{r[1]:>8}{r[2]:>12.6f}{r[3]:>12.6f}"
                      f"{r[4]:>12.6f}{r[5]:>12.6f}")
+    if not rows:
+        lines.append("(no events recorded)")
     return "\n".join(lines)
 
 
@@ -155,9 +173,12 @@ def _host_span_events(pid: int = 90000):
         {"ph": "M", "pid": pid, "name": "process_name",
          "args": {"name": "paddle_tpu host (RecordEvent)"}},
     ]
-    for name, t0, dt in _agg.spans:
+    # spans append at scope EXIT (inner before outer): sort by start so the
+    # exported timeline is monotone in ts
+    for name, t0, dt in sorted(_agg.spans, key=lambda s: s[1]):
         events.append({"ph": "X", "pid": pid, "tid": 0, "name": name,
-                       "ts": t0 * 1e6, "dur": dt * 1e6, "cat": "host"})
+                       "ts": max(t0, 0.0) * 1e6, "dur": max(dt, 0.0) * 1e6,
+                       "cat": "host"})
     return events
 
 
@@ -172,9 +193,6 @@ def export_chrome_tracing(trace_dir: Optional[str] = None,
     Returns output_path (reference tools/timeline.py converted the profiler
     proto the same way).
     """
-    import gzip
-    import json
-
     src = _find_xplane_chrome_trace(trace_dir) if trace_dir else None
     if trace_dir and src is None:
         raise FileNotFoundError(
@@ -182,22 +200,23 @@ def export_chrome_tracing(trace_dir: Optional[str] = None,
             f"pass the directory given to profiler(trace_dir=...) after the "
             f"capture stopped, or call with trace_dir=None for a host-only "
             f"timeline")
+    from .observability import timeline as _obs_timeline
     if src is not None:
-        with gzip.open(src, "rt") as f:
-            trace = json.load(f)
-        trace.setdefault("traceEvents", [])
-    else:
-        if not _agg.spans:
-            raise ValueError(
-                "nothing to export: pass the trace_dir used with "
-                "profiler()/start_profiler, or record host events first "
-                "(FLAGS_profile_executor=1 records one span per "
-                "executor run)")
-        trace = {"traceEvents": _host_span_events(),
-                 "displayTimeUnit": "ms"}
-    with open(output_path, "w") as f:
-        json.dump(trace, f)
-    return output_path
+        # the flight recorder's executor phase spans + counter tracks ride
+        # along on their own pids (RecordEvent spans already appear in the
+        # xplane capture via TraceAnnotation -- not re-synthesized here)
+        return _obs_timeline.splice_into_xplane(
+            src, _obs_timeline._trace_events(), trace_dir, output_path)
+    if not _agg.spans and not _obs_timeline.spans():
+        raise ValueError(
+            "nothing to export: pass the trace_dir used with "
+            "profiler()/start_profiler, or record host events first "
+            "(FLAGS_profile_executor=1 records one span per "
+            "executor run)")
+    # host-only synthesis: RecordEvent spans + flight-recorder phase spans
+    # share one timeline (observability.timeline merges both rings)
+    return _obs_timeline.export_chrome_trace(output_path, trace_dir=None,
+                                             include_profiler=True)
 
 
 def merge_chrome_traces(paths, output_path: str = "timeline.json") -> str:
@@ -213,9 +232,21 @@ def merge_chrome_traces(paths, output_path: str = "timeline.json") -> str:
     # cannot collide with a later input's range.
     offset = 0
     for i, p in enumerate(paths):
-        op = gzip.open(p, "rt") if str(p).endswith(".gz") else open(p)
+        try:
+            op = gzip.open(p, "rt") if str(p).endswith(".gz") else open(p)
+        except OSError as e:
+            raise FileNotFoundError(
+                f"merge_chrome_traces: input {i} ({p!r}) cannot be opened: "
+                f"{e}") from e
         with op as f:
-            t = json.load(f)
+            try:
+                t = json.load(f)
+            except (ValueError, EOFError, OSError) as e:
+                # EOFError/BadGzipFile: a .gz capture truncated mid-write
+                # surfaces during json.load's reads, not at open
+                raise ValueError(
+                    f"merge_chrome_traces: input {i} ({p!r}) is not valid "
+                    f"trace JSON (empty or truncated capture?): {e}") from e
         events = t.get("traceEvents", [])
         pids = [int(e["pid"]) for e in events if "pid" in e]
         base = offset - min(pids) if pids else offset
@@ -229,6 +260,12 @@ def merge_chrome_traces(paths, output_path: str = "timeline.json") -> str:
                                      f"{e['args'].get('name', '')}")
             merged["traceEvents"].append(e)
         offset = base + (max(pids) if pids else 0) + 1
+    # inputs are each internally sorted but their ts ranges overlap (per-
+    # process captures of the same run), so the concatenation drops back at
+    # every file boundary -- re-sort or validate_trace / obs_report --trace
+    # reject the merged file as unsorted
+    merged["traceEvents"].sort(key=lambda e: (e.get("ph") != "M",
+                                              float(e.get("ts", 0.0))))
     with open(output_path, "w") as f:
         json.dump(merged, f)
     return output_path

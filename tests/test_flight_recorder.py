@@ -1,0 +1,716 @@
+"""Flight recorder: phase timeline + Chrome-trace export, tensor-health
+watchdog, device-memory telemetry, step-time anomaly detection, and the
+no-hot-path-I/O guard (PR 2 acceptance pins)."""
+import builtins
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.observability import (anomaly, health, journal, memory,
+                                      timeline)
+from paddle_tpu.observability.metrics import REGISTRY, MetricsRegistry
+
+
+def _counter_val(name, **labels):
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+    child = fam.children.get(key)
+    return child.value if child is not None else 0.0
+
+
+def _loss_program(dim=4):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [dim], "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 4))
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------- timeline --
+
+@pytest.mark.smoke
+def test_executor_phase_spans_and_trace_export(tmp_path, monkeypatch):
+    """Acceptance pin: a 3-step run under PADDLE_TPU_OBS=1 yields a valid
+    Chrome trace containing executor phase spans (feed_prep/dispatch/
+    fetch_sync), record_event host spans, and >=1 memory counter track."""
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", str(tmp_path / "j.jsonl"))
+    timeline.clear()
+    main, startup, loss = _loss_program(dim=13)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 13), "float32")}
+    from paddle_tpu import profiler
+    profiler.start_profiler()
+    fluid.set_flags({"FLAGS_profile_executor": True})
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed=feed, fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_profile_executor": False})
+        profiler.stop_profiler(profile_path=os.devnull)
+
+    names = {s[0] for s in timeline.spans()}
+    assert {"feed_prep", "dispatch", "fetch_sync", "compile",
+            "journal"} <= names
+    # spans carry the per-program step index
+    steps = [s[4]["step"] for s in timeline.spans("dispatch")
+             if s[4] and s[4].get("program", "").startswith(str(id(main)))]
+    assert steps == [0, 1, 2]
+
+    out = timeline.export_chrome_trace(str(tmp_path / "trace.json"))
+    events = timeline.validate_trace(out)       # valid + monotone ts
+    span_names = {e["name"] for e in events if e.get("ph") == "X"}
+    assert {"feed_prep", "dispatch", "fetch_sync"} <= span_names
+    assert any(e["name"].startswith("executor_run_v") for e in events
+               if e.get("ph") == "X")           # record_event host span
+    counter_tracks = {e["name"] for e in events if e.get("ph") == "C"}
+    assert "device_memory_bytes" in counter_tracks
+    profiler.reset_profiler()
+
+
+def test_phase_seconds_histogram_mirrors_spans():
+    timeline.clear()
+    h = REGISTRY.histogram("phase_seconds", phase="unit_phase", cat="test")
+    n0 = h.count
+    with timeline.phase("unit_phase", cat="test", step=7):
+        pass
+    timeline.record_span("unit_phase", 1.0, 0.001, cat="test", step=8)
+    assert h.count == n0 + 2
+    assert len(timeline.spans("unit_phase")) == 2
+    # same phase name, different category: its own series (executor vs
+    # Predictor dispatch times must not share a histogram)
+    other = REGISTRY.histogram("phase_seconds", phase="unit_phase",
+                               cat="other")
+    m0 = other.count
+    timeline.record_span("unit_phase", 2.0, 0.001, cat="other")
+    assert h.count == n0 + 2 and other.count == m0 + 1
+
+
+def test_validate_trace_rejects_malformed(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "a", "ts": -5.0, "dur": 1.0, "pid": 1}]}))
+    with pytest.raises(ValueError, match="negative"):
+        timeline.validate_trace(str(bad))
+    unsorted = tmp_path / "unsorted.json"
+    unsorted.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "a", "ts": 9.0, "dur": 1.0, "pid": 1},
+        {"ph": "X", "name": "b", "ts": 1.0, "dur": 1.0, "pid": 1}]}))
+    with pytest.raises(ValueError, match="sorted"):
+        timeline.validate_trace(str(unsorted))
+
+
+def test_train_from_dataset_records_feed_wait_spans(tmp_path):
+    data_file = tmp_path / "d.txt"
+    data_file.write_text("".join(
+        "%d;%d\n" % (i % 5, i % 3) for i in range(12)))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        a = fluid.data("a", [1], "int64")
+        b = fluid.data("b", [1], "int64")
+        s = fluid.layers.cast(a + b, "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(s, 2))
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var([a, b])
+    ds.set_filelist([str(data_file)])
+    timeline.clear()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.train_from_dataset(main, dataset=ds, fetch_list=[loss])
+    assert timeline.spans("feed_wait"), "prefetch consumer recorded no waits"
+
+
+# ------------------------------------------------------------------ health --
+
+def test_health_raise_names_offending_fetch(monkeypatch, tmp_path):
+    """Acceptance pin: NaN in a fetched loss under HEALTH=raise raises with
+    the variable name and journals a tensor_nonfinite event."""
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "raise")
+    journal.clear()
+    main, startup, loss = _loss_program(dim=3)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(FloatingPointError) as ei:
+            exe.run(main, feed={"x": np.full((2, 3), np.inf, "float32")},
+                    fetch_list=[loss])
+    assert loss.name in str(ei.value)
+    evs = journal.recent(event="tensor_nonfinite")
+    assert evs and evs[-1]["var"] == loss.name
+    assert evs[-1]["where"] == "executor"
+    assert _counter_val("tensor_nonfinite_total", where="executor") >= 1
+
+
+def test_health_warn_mode_continues(monkeypatch, recwarn):
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "warn")
+    main, startup, loss = _loss_program(dim=5)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out = exe.run(main, feed={"x": np.full((2, 5), np.nan, "float32")},
+                      fetch_list=[loss])
+    assert math.isnan(float(np.asarray(out[0])))   # run completed
+    assert any("NaN/Inf" in str(w.message) for w in recwarn.list)
+
+
+def test_health_off_never_scans(monkeypatch):
+    """Acceptance pin: with the mode off the watchdog adds no device work --
+    the scan entry point must not even be reached."""
+    monkeypatch.delenv("PADDLE_TPU_OBS_HEALTH", raising=False)
+
+    def boom(*a, **k):
+        raise AssertionError("health scan ran with PADDLE_TPU_OBS_HEALTH off")
+
+    monkeypatch.setattr(health, "nonfinite_names", boom)
+    main, startup, loss = _loss_program(dim=6)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.full((2, 6), np.nan, "float32")},
+                fetch_list=[loss])   # NaN, but nobody looks
+
+
+def test_health_healthy_run_is_silent(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "raise")
+    journal.clear()
+    main, startup, loss = _loss_program(dim=7)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 7), "float32")},
+                fetch_list=[loss])
+    assert journal.recent(event="tensor_nonfinite") == []
+
+
+def test_health_skips_integer_tensors():
+    assert health.nonfinite_names(
+        [("ids", np.arange(4)), ("mask", np.ones(3, bool))]) == []
+
+
+def test_health_state_scan(monkeypatch):
+    """PADDLE_TPU_OBS_HEALTH_STATE=1 extends the scan to written state: a
+    NaN feed poisons the fc weight through the optimizer update."""
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "raise")
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH_STATE", "1")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(FloatingPointError):
+            # no fetch_list: only the state scan can catch it
+            exe.run(main, feed={"x": np.full((2, 4), np.nan, "float32")},
+                    fetch_list=[])
+
+
+def test_health_bad_mode_rejected(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "sometimes")
+    with pytest.raises(ValueError, match="PADDLE_TPU_OBS_HEALTH"):
+        health.mode()
+
+
+def test_health_mode_toggle_aliases(monkeypatch):
+    """The 0/1 spelling every sibling env var uses must work, not crash the
+    first Executor.run: truthy -> warn, falsy -> off."""
+    for raw, want in (("1", "warn"), ("true", "warn"), ("on", "warn"),
+                      ("0", "off"), ("false", "off"), ("", "off"),
+                      ("RAISE", "raise")):
+        monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", raw)
+        assert health.mode() == want, raw
+
+
+# ------------------------------------------------------------------ memory --
+
+def test_memory_sample_sets_gauges_and_counter_track():
+    timeline.clear()
+    reg = MetricsRegistry()
+    snap = memory.sample_device_memory("test", registry=reg)
+    assert snap, "no devices sampled"
+    for dev, vals in snap.items():
+        assert vals["bytes_in_use"] >= 0
+        assert vals["peak_bytes"] >= vals["bytes_in_use"] or \
+            vals["peak_bytes"] >= 0
+        assert reg.gauge("device_memory_bytes_in_use",
+                         device=dev).value == vals["bytes_in_use"]
+    assert reg.counter("memory_samples_total", reason="test").value == 1
+    assert timeline.counters("device_memory_bytes")
+
+
+def test_program_memory_gauges_after_compile():
+    main, startup, loss = _loss_program(dim=9)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 9), "float32")},
+                fetch_list=[loss])
+    label = f"{id(main)}:v{main._version}"
+    fam = REGISTRY.get("program_peak_bytes")
+    assert fam is not None
+    key = (("program", label),)
+    assert key in fam.children and fam.children[key].value > 0
+    # compile-time occupancy samples happened
+    assert _counter_val("memory_samples_total", reason="compile") >= 1
+
+
+def test_memory_interval_sampling(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", str(tmp_path / "j.jsonl"))
+    monkeypatch.setenv("PADDLE_TPU_OBS_MEM_INTERVAL", "2")
+    assert memory.sample_interval() == 2
+    c0 = _counter_val("memory_samples_total", reason="interval")
+    main, startup, loss = _loss_program(dim=10)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(4):
+            exe.run(main, feed={"x": np.ones((2, 10), "float32")},
+                    fetch_list=[loss])
+    # 5 journaled runs (startup + 4) at interval 2 -> 2 interval samples
+    assert _counter_val("memory_samples_total", reason="interval") == c0 + 2
+
+
+def test_memory_interval_env_fallback(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OBS_MEM_INTERVAL", "not-a-number")
+    assert memory.sample_interval() == memory.DEFAULT_INTERVAL
+    monkeypatch.setenv("PADDLE_TPU_OBS_MEM_INTERVAL", "0")
+    assert memory.sample_interval() == 1
+
+
+# ----------------------------------------------------------------- anomaly --
+
+def test_anomaly_detector_flags_spike_and_journals():
+    journal.clear()
+    reg = MetricsRegistry()
+    det = anomaly.StepTimeAnomalyDetector(registry=reg)
+    for _ in range(16):
+        assert det.observe("p:v0", 0.010) is None   # steady state: quiet
+    rec = det.observe("p:v0", 0.200)                # 20x spike
+    assert rec is not None and rec["event"] == "step_time_anomaly"
+    assert rec["step_ms"] == 200.0 and rec["program"] == "p:v0"
+    assert reg.counter("anomaly_total", kind="step_time").value == 1
+    evs = journal.recent(event="step_time_anomaly")
+    assert evs and evs[-1]["step_ms"] == 200.0
+
+
+def test_anomaly_detector_warmup_and_jitter_tolerance():
+    det = anomaly.StepTimeAnomalyDetector(registry=MetricsRegistry())
+    # fewer than min_samples: never flags, even for a huge value
+    for _ in range(det.min_samples - 1):
+        assert det.observe("p", 0.01) is None
+    assert det.observe("p", 10.0) is None   # window still warming up
+    det2 = anomaly.StepTimeAnomalyDetector(registry=MetricsRegistry())
+    # +/-8% noise around 10ms stays under the relative floor
+    vals = [0.010 + 0.0008 * ((i % 5) - 2) for i in range(40)]
+    assert all(det2.observe("q", v) is None for v in vals)
+
+
+def test_anomaly_windows_keyed_per_cache_entry():
+    """Two feed signatures of one program may legitimately differ by large
+    factors; they must not share a median (the executor passes its compile
+    cache key as the window key), and eviction retires exactly one window."""
+    det = anomaly.StepTimeAnomalyDetector(registry=MetricsRegistry())
+    for _ in range(16):
+        det.observe("p:v0", 0.010, key=("p", "small"))
+    # slower shape, same label, own window: still warming up, not anomalous
+    assert det.observe("p:v0", 0.500, key=("p", "big")) is None
+    # same window would have flagged: prove it by feeding the small key
+    assert det.observe("p:v0", 0.500, key=("p", "small")) is not None
+    det.retire(("p", "small"))
+    assert det.observe("p:v0", 0.500, key=("p", "small")) is None  # fresh
+
+
+def test_anomaly_executor_feeds_warm_steps_only(monkeypatch, tmp_path):
+    observed = []
+    monkeypatch.setattr(
+        anomaly.DETECTOR, "observe",
+        lambda label, s, key=None: observed.append((label, s, key)))
+    monkeypatch.delenv("PADDLE_TPU_OBS", raising=False)
+    main, startup, loss = _loss_program(dim=11)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 11), "float32")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])  # compile: not observed
+        exe.run(main, feed=feed, fetch_list=[loss])  # warm but obs off: the
+        # un-synced run_s is bare dispatch time -- must not feed the window
+        assert observed == []
+        monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+        monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", str(tmp_path / "j.jsonl"))
+        exe.run(main, feed=feed, fetch_list=[loss])  # warm + synced: observed
+    main_label = f"{id(main)}:v{main._version}"
+    assert [o for o in observed if o[0] == main_label] and \
+        all(o[0] != main_label or o[1] > 0 for o in observed)
+    # exactly one warm synced main-program step
+    assert sum(1 for o in observed if o[0] == main_label) == 1
+
+
+# ------------------------------------------------------------ no-I/O guard --
+
+@pytest.mark.smoke
+def test_no_journal_or_trace_io_when_obs_unset(tmp_path, monkeypatch):
+    """Tier-1 guard: a 3-step Executor.run with every observability env var
+    unset performs ZERO open() calls on the journal/trace paths."""
+    for var in ("PADDLE_TPU_OBS", "PADDLE_TPU_OBS_HEALTH",
+                "PADDLE_TPU_OBS_HEALTH_STATE", "PADDLE_TPU_OBS_MEM_INTERVAL"):
+        monkeypatch.delenv(var, raising=False)
+    jpath = str(tmp_path / "guard_journal.jsonl")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", jpath)
+    monkeypatch.chdir(tmp_path)
+
+    main, startup, loss = _loss_program(dim=8)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 8), "float32")}
+    opened = []
+    real_open = builtins.open
+
+    def spy_open(file, *a, **k):
+        opened.append(str(file))
+        return real_open(file, *a, **k)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])   # compile outside spy
+        monkeypatch.setattr(builtins, "open", spy_open)
+        try:
+            for _ in range(3):
+                exe.run(main, feed=feed, fetch_list=[loss])
+        finally:
+            monkeypatch.setattr(builtins, "open", real_open)
+    watched = [p for p in opened
+               if "journal" in p or "trace" in p or "timeline" in p
+               or p.endswith(".jsonl") or "paddle_tpu_obs" in p]
+    assert watched == [], f"hot path opened observability files: {watched}"
+    assert not os.path.exists(jpath)
+    assert list(tmp_path.iterdir()) == []
+
+
+# --------------------------------------------------- profiler trace export --
+
+def test_export_chrome_tracing_unifies_host_and_phase_spans(tmp_path):
+    """Satellite pin: RecordEvent host spans and executor phase spans land
+    in ONE valid trace file; ts/dur are non-negative and sorted."""
+    from paddle_tpu import profiler
+    timeline.clear()
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.record_event("unify_host_span"):
+        with timeline.phase("unify_exec_phase", step=0):
+            pass
+    profiler.stop_profiler(profile_path=os.devnull)
+    out = profiler.export_chrome_tracing(None, str(tmp_path / "t.json"))
+    events = timeline.validate_trace(out)
+    names = {e["name"] for e in events if e.get("ph") == "X"}
+    assert {"unify_host_span", "unify_exec_phase"} <= names
+    for e in events:
+        if e.get("ph") == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    profiler.reset_profiler()
+
+
+def test_merge_chrome_traces_missing_and_empty_inputs(tmp_path):
+    from paddle_tpu import profiler
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "a", "ts": 1.0, "dur": 1.0, "pid": 1}]}))
+    with pytest.raises(FileNotFoundError, match="cannot be opened"):
+        profiler.merge_chrome_traces(
+            [str(ok), str(tmp_path / "nope.json")],
+            str(tmp_path / "m.json"))
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="not valid trace JSON"):
+        profiler.merge_chrome_traces([str(ok), str(empty)],
+                                     str(tmp_path / "m2.json"))
+    # valid inputs still merge
+    merged = profiler.merge_chrome_traces([str(ok), str(ok)],
+                                          str(tmp_path / "m3.json"))
+    with open(merged) as f:
+        evs = json.load(f)["traceEvents"]
+    assert len(evs) == 2 and len({e["pid"] for e in evs}) == 2
+
+
+def test_export_with_xplane_capture_skips_host_span_synthesis(tmp_path):
+    """With an xplane capture the RecordEvent spans already ride it via
+    TraceAnnotation -- synthesizing them again would double-count every
+    span in obs_report's timeline section."""
+    import gzip
+    from paddle_tpu import profiler
+    timeline.clear()
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.record_event("dup_host_span"):
+        with timeline.phase("exec_phase_x", step=0):
+            pass
+    profiler.stop_profiler(profile_path=os.devnull)
+    (tmp_path / "cap").mkdir()
+    (tmp_path / "cap" / "x.trace.json.gz").write_bytes(gzip.compress(
+        json.dumps({"traceEvents": [
+            {"ph": "X", "name": "dup_host_span", "ts": 10.0, "dur": 2.0,
+             "pid": 1}]}).encode()))
+    out = timeline.export_chrome_trace(str(tmp_path / "t.json"),
+                                       trace_dir=str(tmp_path))
+    events = timeline.validate_trace(out)
+    assert sum(1 for e in events if e.get("ph") == "X"
+               and e["name"] == "dup_host_span") == 1
+    assert any(e.get("ph") == "X" and e["name"] == "exec_phase_x"
+               for e in events)   # flight-recorder phases still ride along
+    # a trace_dir with no capture is a caller error, not a silent host-only
+    # file masquerading as the device timeline
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(FileNotFoundError, match="xplane"):
+        timeline.export_chrome_trace(str(tmp_path / "t2.json"),
+                                     trace_dir=str(tmp_path / "empty"))
+    profiler.reset_profiler()
+
+
+def test_merge_chrome_traces_resorts_overlapping_inputs(tmp_path):
+    """Per-process captures of one run overlap in ts; the merged file must
+    still be monotone or obs_report --trace rejects it."""
+    from paddle_tpu import profiler
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "a0", "ts": 1.0, "dur": 1.0, "pid": 1},
+        {"ph": "X", "name": "a1", "ts": 9.0, "dur": 1.0, "pid": 1}]}))
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 2, "args": {"name": "x"}},
+        {"ph": "X", "name": "b0", "ts": 2.0, "dur": 1.0, "pid": 2}]}))
+    merged = profiler.merge_chrome_traces([str(a), str(b)],
+                                          str(tmp_path / "m.json"))
+    events = timeline.validate_trace(merged)   # raises if not sorted
+    xs = [e["name"] for e in events if e.get("ph") == "X"]
+    assert xs == ["a0", "b0", "a1"]
+
+
+def test_shift_onto_xplane_aligns_clock_domains(monkeypatch):
+    """perf_counter-domain spans must be re-anchored onto the xplane
+    capture's own ts epoch, not merged hours away from the device events."""
+    from paddle_tpu import profiler
+    xplane = [{"ph": "M", "pid": 1, "name": "process_name", "args": {}},
+              {"ph": "X", "name": "dev_op", "ts": 500.0, "dur": 5.0,
+               "pid": 1}]
+    # capture in dir "d" started at perf_counter == 2.0 s; span 100 us later
+    monkeypatch.setattr(profiler._agg, "trace_anchor", ("d", 2e6),
+                        raising=False)
+    perf = [{"ph": "X", "name": "phase", "ts": 2e6 + 100.0, "dur": 3.0,
+             "pid": 90001}]
+    out = timeline._shift_onto_xplane(perf, xplane, "d")
+    assert out[0]["ts"] == pytest.approx(600.0)   # 500 + 100
+    # anchor from a DIFFERENT capture dir must not apply: min-align instead
+    out2 = timeline._shift_onto_xplane(perf, xplane, "other_dir")
+    assert out2[0]["ts"] == pytest.approx(500.0)
+    # no anchor at all: the two minima align (best effort)
+    monkeypatch.setattr(profiler._agg, "trace_anchor", None, raising=False)
+    out3 = timeline._shift_onto_xplane(perf, xplane, "d")
+    assert out3[0]["ts"] == pytest.approx(500.0)
+    # spans that began before the capture clamp to 0, keeping the file valid
+    monkeypatch.setattr(profiler._agg, "trace_anchor", ("d", 3e6),
+                        raising=False)
+    out4 = timeline._shift_onto_xplane(perf, xplane, "d")
+    assert out4[0]["ts"] == 0.0
+
+
+def test_profiler_summary_empty_is_well_formed():
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    table = profiler.summary()
+    assert "Event" in table and "Calls" in table
+    assert "(no events recorded)" in table
+    # stop on a never-enabled aggregate: same well-formed empty table, and
+    # no defaultdict side-effect rows appear afterwards
+    table2 = profiler.stop_profiler(profile_path=os.devnull)
+    assert "(no events recorded)" in table2
+    assert profiler._agg.times == {}
+
+
+def test_start_profiler_clears_previous_sessions_spans():
+    """A second profiling session must not export the first one's
+    RecordEvent spans (pre-capture spans would clamp to ts 0 in a spliced
+    xplane timeline)."""
+    from paddle_tpu import profiler
+    profiler.start_profiler()
+    with profiler.record_event("session_a_span"):
+        pass
+    profiler.stop_profiler(profile_path=os.devnull)
+    profiler.start_profiler()
+    with profiler.record_event("session_b_span"):
+        pass
+    profiler.stop_profiler(profile_path=os.devnull)
+    names = [s[0] for s in profiler._agg.spans]
+    assert "session_b_span" in names and "session_a_span" not in names
+    profiler.reset_profiler()
+
+
+def test_executor_close_retires_telemetry(monkeypatch, tmp_path):
+    """close() drops the compile cache, so it must retire the per-program
+    gauges and anomaly windows with it -- same invariant as eviction."""
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", str(tmp_path / "j.jsonl"))
+    main, startup, loss = _loss_program(dim=9)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 9), "float32")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        exe.run(main, feed=feed, fetch_list=[loss])   # warm: feeds a window
+    label = f"{id(main)}:v{main._version}"
+
+    def has_gauge():
+        fam = REGISTRY.get("program_flops")
+        return bool(fam) and any(dict(k).get("program") == label
+                                 for k in fam.children)
+
+    def has_window():
+        return any(isinstance(k, tuple) and k and k[0] == id(main)
+                   for k in anomaly.DETECTOR._windows)
+
+    assert has_gauge() and has_window()
+    exe.close()
+    assert not has_gauge() and not has_window()
+
+
+def test_executor_close_keeps_sibling_telemetry(monkeypatch, tmp_path):
+    """Gauges are process-global: closing one executor must not delete a
+    label a still-live sibling executor caches."""
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL", str(tmp_path / "j.jsonl"))
+    main, startup, loss = _loss_program(dim=10)
+    feed = {"x": np.ones((2, 10), "float32")}
+    exe_a, exe_b = fluid.Executor(), fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe_a.run(startup)
+        exe_a.run(main, feed=feed, fetch_list=[loss])
+        exe_b.run(main, feed=feed, fetch_list=[loss])
+    label = f"{id(main)}:v{main._version}"
+
+    def has_gauge():
+        fam = REGISTRY.get("program_flops")
+        return bool(fam) and any(dict(k).get("program") == label
+                                 for k in fam.children)
+
+    assert has_gauge()
+    exe_b.close()
+    assert has_gauge()       # exe_a still caches the label
+    exe_a.close()
+    assert not has_gauge()   # last live entry anywhere: now retired
+
+
+def test_reset_profiler_clears_spans():
+    from paddle_tpu import profiler
+    profiler.start_profiler()
+    with profiler.record_event("span_to_clear"):
+        pass
+    profiler.stop_profiler(profile_path=os.devnull)
+    assert profiler._agg.spans
+    profiler.reset_profiler()
+    assert profiler._agg.spans == [] and profiler._agg.times == {}
+
+
+# --------------------------------------------------------------- predictor --
+
+def test_predictor_phases_and_health(tmp_path, monkeypatch):
+    import paddle_tpu.io as io
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    model_dir = str(tmp_path / "model")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        io.save_inference_model(model_dir, ["x"], [y], exe,
+                                main_program=main)
+    from paddle_tpu.inference import Predictor
+    timeline.clear()
+    pred = Predictor(model_dir)
+    out = pred.run({"x": np.ones((2, 4), "float32")})
+    assert out[0].shape == (2, 2)
+    cats = {s[1] for s in timeline.spans()}
+    assert "predictor" in cats
+    names = {s[0] for s in timeline.spans() if s[1] == "predictor"}
+    assert {"feed_prep", "dispatch", "fetch_sync"} <= names
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "raise")
+    with pytest.raises(FloatingPointError):
+        pred.run({"x": np.full((2, 4), np.nan, "float32")})
+
+
+# -------------------------------------------------------------- obs_report --
+
+def test_obs_report_trace_cli(tmp_path):
+    timeline.clear()
+    timeline.record_span("feed_prep", 1.0, 0.001, step=0)
+    timeline.record_span("dispatch", 1.001, 0.004, step=0)
+    timeline.counter_sample("device_memory_bytes", {"cpu:0": 1e6}, t=1.005)
+    tpath = timeline.export_chrome_trace(str(tmp_path / "t.json"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-m", "tools.obs_report",
+                        "--trace", tpath], cwd=repo, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "== Timeline ==" in r.stdout
+    assert "feed_prep" in r.stdout and "dispatch" in r.stdout
+    assert "device_memory_bytes" in r.stdout
+
+
+def test_obs_report_health_memory_sections():
+    from tools.obs_report import render_health, render_memory
+    events = [
+        {"event": "tensor_nonfinite", "program": "9:v1", "where": "executor",
+         "var": "loss", "vars": ["loss"]},
+        {"event": "step_time_anomaly", "program": "9:v1", "step_ms": 80.0,
+         "median_ms": 8.0, "mad_ms": 0.4, "limit_ms": 11.2, "n_window": 64},
+    ]
+    h = render_health(events)
+    assert "NONFINITE" in h and "'loss'" in h and "80.0ms" in h
+    snapshot = {"families": [
+        {"name": "device_memory_bytes_in_use", "type": "gauge", "help": "",
+         "samples": [{"labels": {"device": "tpu:0"}, "value": 2.5e9}]},
+        {"name": "program_peak_bytes", "type": "gauge", "help": "",
+         "samples": [{"labels": {"program": "9:v1"}, "value": 4e9}]},
+    ]}
+    m = render_memory(snapshot)
+    assert "tpu:0" in m and "2.500 GB" in m and "peak 4.000 GB" in m
+    # a Prometheus text dump parses to one single-sample family PER series
+    # (duplicate names): every device must still render, not just the last
+    prom_shape = {"families": [
+        {"name": "device_memory_bytes_in_use", "type": "gauge", "help": "",
+         "samples": [{"labels": {"device": f"tpu:{i}"}, "value": 1e9 * (i + 1)}]}
+        for i in range(3)]}
+    m2 = render_memory(prom_shape)
+    assert "tpu:0" in m2 and "tpu:1" in m2 and "tpu:2" in m2
+
+
+def test_pipeline_schedule_span():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel import pipeline_spmd
+
+    timeline.clear()
+    S, M, MB, D = 2, 3, 2, 4
+    mesh = Mesh(np.array(jax.devices()[:S]).reshape(S), ("pp",))
+    W = np.tile(np.eye(D, dtype="float32")[None], (S, 1, 1))
+    x = np.ones((M, MB, D), "float32")
+    pipeline_spmd(lambda p, h: h @ p, jnp.asarray(W), jnp.asarray(x), mesh,
+                  axis="pp")
+    spans = timeline.spans("pipeline_schedule")
+    assert spans and spans[-1][4]["stages"] == S
+    assert spans[-1][4]["ticks"] == M + S - 1

@@ -88,6 +88,122 @@ def render_journal(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+# ----------------------------------------------------------------- health --
+
+def render_health(events: List[dict]) -> str:
+    """Tensor-health watchdog + step-time anomaly verdicts in the journal."""
+    lines = ["== Health =="]
+    nonf = [e for e in events if e.get("event") == "tensor_nonfinite"]
+    anom = [e for e in events if e.get("event") == "step_time_anomaly"]
+    if not nonf and not anom:
+        lines.append("healthy: no tensor_nonfinite or step_time_anomaly "
+                     "events")
+        return "\n".join(lines)
+    if len(nonf) > 10:
+        # a loss that goes NaN journals one event per remaining step; the
+        # report must stay readable, same last-10 cap as the anomaly list
+        lines.append(f"{len(nonf)} tensor_nonfinite events (last 10):")
+    for e in nonf[-10:]:
+        lines.append(f"NONFINITE {e.get('where', '?')} program "
+                     f"{e.get('program')}: first offender "
+                     f"{e.get('var')!r} (all: {e.get('vars')})")
+    if anom:
+        lines.append(f"{len(anom)} step-time anomalies"
+                     + (" (last 10):" if len(anom) > 10 else ":"))
+        for e in anom[-10:]:
+            lines.append(
+                f"  program {e.get('program')}: step "
+                f"{e.get('step_ms')}ms vs median {e.get('median_ms')}ms "
+                f"(MAD {e.get('mad_ms')}ms, limit {e.get('limit_ms')}ms)")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- memory --
+
+_MEMORY_FAMILIES = ("device_memory_bytes_in_use", "device_memory_peak_bytes",
+                    "program_peak_bytes", "program_temp_bytes",
+                    "program_argument_bytes", "program_output_bytes")
+
+
+def _gb(v: float) -> str:
+    return (f"{v / 1e9:.3f} GB" if v >= 1e9 else
+            f"{v / 1e6:.3f} MB" if v >= 1e6 else f"{v:.0f} B")
+
+
+def render_memory(snapshot: dict) -> str:
+    """Device occupancy gauges + per-program XLA footprint, human units."""
+    lines = ["== Device memory =="]
+    # accumulate samples across same-named families: a Prometheus text dump
+    # parses to one single-sample family PER series, so a last-wins dict
+    # would silently drop all but one device/program
+    fams = {}
+    for f in snapshot.get("families", []):
+        if f["name"] in _MEMORY_FAMILIES:
+            fams.setdefault(f["name"], {"samples": []})["samples"].extend(
+                f.get("samples", []))
+    if not fams:
+        lines.append("(no memory samples; run with PADDLE_TPU_OBS=1 or "
+                     "compile at least one program)")
+        return "\n".join(lines)
+    for name in ("device_memory_bytes_in_use", "device_memory_peak_bytes"):
+        for s in fams.get(name, {}).get("samples", []):
+            dev = s.get("labels", {}).get("device", "?")
+            what = "in use" if name.endswith("in_use") else "peak"
+            lines.append(f"  {dev}: {_gb(s.get('value', 0.0))} {what}")
+    progs = {}
+    for name in ("program_peak_bytes", "program_temp_bytes",
+                 "program_argument_bytes", "program_output_bytes"):
+        for s in fams.get(name, {}).get("samples", []):
+            label = s.get("labels", {}).get("program", "?")
+            progs.setdefault(label, {})[name] = s.get("value", 0.0)
+    for label, parts in sorted(progs.items()):
+        peak = parts.get("program_peak_bytes", 0.0)
+        lines.append(
+            f"  program {label}: peak {_gb(peak)} "
+            f"(args {_gb(parts.get('program_argument_bytes', 0.0))}, "
+            f"temp {_gb(parts.get('program_temp_bytes', 0.0))}, "
+            f"out {_gb(parts.get('program_output_bytes', 0.0))})")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------- timeline --
+
+def render_timeline(trace_events: List[dict]) -> str:
+    """Chrome-trace event list -> per-phase span summary + counter tracks."""
+    lines = ["== Timeline =="]
+    spans = [e for e in trace_events if e.get("ph") == "X"]
+    counts = [e for e in trace_events if e.get("ph") == "C"]
+    if not spans and not counts:
+        lines.append("(no trace events)")
+        return "\n".join(lines)
+    by_name = {}
+    for e in spans:
+        # group by (name, category): executor and Predictor both record
+        # dispatch/feed_prep/fetch_sync spans and merging them would
+        # describe neither workload
+        key = (e.get("name", "?"), e.get("cat", ""))
+        by_name.setdefault(key, []).append(
+            float(e.get("dur", 0.0)) / 1e3)   # us -> ms
+    lines.append(f"{len(spans)} spans over {len(by_name)} phases:")
+    for (name, cat), durs in sorted(by_name.items(),
+                                    key=lambda kv: -sum(kv[1])):
+        shown = name if cat in ("", "executor") else f"{name} [{cat}]"
+        lines.append(f"  {shown}: " + _stats(durs))
+    tracks = {}
+    for e in counts:
+        tracks.setdefault(e.get("name", "?"), 0)
+        tracks[e.get("name", "?")] += 1
+    for t, n in sorted(tracks.items()):
+        lines.append(f"  counter track {t!r}: {n} samples")
+    return "\n".join(lines)
+
+
+def load_trace(path: str) -> List[dict]:
+    # callers (main, selftest) have already bootstrapped sys.path
+    from paddle_tpu.observability.timeline import validate_trace
+    return validate_trace(path)
+
+
 # ---------------------------------------------------------------- metrics --
 
 def render_metrics(snapshot: dict) -> str:
@@ -140,12 +256,17 @@ def load_metrics(path: str) -> dict:
 
 
 def render_report(events: Optional[List[dict]],
-                  snapshot: Optional[dict]) -> str:
+                  snapshot: Optional[dict],
+                  trace_events: Optional[List[dict]] = None) -> str:
     parts = ["# paddle_tpu observability report"]
     if events is not None:
         parts.append(render_journal(events))
+        parts.append(render_health(events))
+    if trace_events is not None:
+        parts.append(render_timeline(trace_events))
     if snapshot is not None:
         parts.append(render_metrics(snapshot))
+        parts.append(render_memory(snapshot))
     if events:
         tail = events[-10:]
         parts.append("== Journal tail ==")
@@ -174,6 +295,12 @@ def selftest() -> int:
     h = reg.histogram("executor_run_seconds")
     for v in (0.002, 0.004, 0.008, 0.5):
         h.observe(v)
+    reg.gauge("device_memory_bytes_in_use", device="cpu:0").set(512e6)
+    reg.gauge("device_memory_peak_bytes", device="cpu:0").set(2e9)
+    reg.gauge("program_peak_bytes", program="1:v0").set(1.5e9)
+    reg.gauge("program_temp_bytes", program="1:v0").set(3e8)
+    reg.counter("tensor_nonfinite_total", where="executor").inc()
+    reg.counter("anomaly_total", kind="step_time").inc()
 
     events = [
         {"event": "run", "program": 1, "version": 0, "cache": "miss",
@@ -184,7 +311,15 @@ def selftest() -> int:
          "feed": {"x": [[8, 3], "float32"]}, "fetch": ["loss"], "ts": 1.0},
         {"event": "recompile", "program": 1, "version": 0,
          "changed": ["shape"], "ts": 2.0},
+        {"event": "tensor_nonfinite", "program": "1:v0",
+         "where": "executor", "var": "loss", "vars": ["loss"], "ts": 3.0},
+        {"event": "step_time_anomaly", "program": "1:v0", "step_ms": 99.0,
+         "median_ms": 4.0, "mad_ms": 0.2, "limit_ms": 5.6, "n_window": 32,
+         "ts": 4.0},
     ]
+
+    # a synthetic flight-recorder trace through the real exporter
+    from paddle_tpu.observability import timeline as obs_timeline
 
     with tempfile.TemporaryDirectory() as td:
         jpath = os.path.join(td, "journal.jsonl")
@@ -196,16 +331,51 @@ def selftest() -> int:
         ppath = os.path.join(td, "metrics.prom")
         with open(ppath, "w") as f:
             f.write(obs_export.to_prometheus(reg))
+        # synthetic spans through the real exporter, hermetically: snapshot
+        # and restore the process-global ring (raw appends, not
+        # record_span, so the global phase_seconds histogram isn't
+        # polluted either), and keep the host's real RecordEvent spans out
+        saved = (obs_timeline.spans(), obs_timeline.counters())
+        obs_timeline.clear()
+        try:
+            with obs_timeline._lock:
+                obs_timeline._spans.append(
+                    ("feed_prep", "executor", 1.0, 0.002, {"step": 0}))
+                obs_timeline._spans.append(
+                    ("dispatch", "executor", 1.002, 0.009, {"step": 0}))
+                obs_timeline._counters.append(
+                    ("device_memory_bytes", 1.011, {"cpu:0": 512e6}))
+            tpath = obs_timeline.export_chrome_trace(
+                os.path.join(td, "trace.json"), include_profiler=False)
+        finally:
+            with obs_timeline._lock:
+                obs_timeline._spans.clear()
+                obs_timeline._spans.extend(saved[0])
+                obs_timeline._counters.clear()
+                obs_timeline._counters.extend(saved[1])
 
         from paddle_tpu.observability.journal import read_journal
-        report = render_report(read_journal(jpath), load_metrics(mpath))
+        report = render_report(read_journal(jpath), load_metrics(mpath),
+                               load_trace(tpath))
         for must in ("2 executor runs", "1 recompiles", "hit rate",
                      "changed ['shape']", "program_mfu", "0.42",
-                     "executor_run_seconds", "n=4"):
+                     "executor_run_seconds", "n=4",
+                     # health section
+                     "NONFINITE executor", "'loss'", "step-time anomalies",
+                     "99.0ms",
+                     # memory section
+                     "cpu:0", "512.000 MB", "peak 1.500 GB",
+                     # timeline section
+                     "feed_prep", "dispatch",
+                     "counter track 'device_memory_bytes'"):
             assert must in report, f"selftest: {must!r} missing from:\n{report}"
         # prometheus dump must also load + render
         prom_report = render_report(None, load_metrics(ppath))
         assert "executor_cache_hits_total" in prom_report
+        # empty journal/trace render degrades, never raises
+        assert "healthy" in render_health([])
+        assert "(no trace events)" in render_timeline([])
+        assert "no memory samples" in render_memory({"families": []})
     print("obs_report selftest: OK")
     return 0
 
@@ -220,6 +390,10 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", default=None,
                     help="metrics dump: bench --emit-metrics JSON or "
                          "Prometheus text (auto-detected)")
+    ap.add_argument("--trace", default=None,
+                    help="Chrome-trace JSON (bench --emit-trace / "
+                         "observability.export_chrome_trace) to summarize "
+                         "as a per-phase timeline section")
     ap.add_argument("--live", action="store_true",
                     help="render this process's in-memory registry")
     ap.add_argument("--selftest", action="store_true")
@@ -229,7 +403,7 @@ def main(argv=None) -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    events = snapshot = None
+    events = snapshot = trace_events = None
     jpath = args.journal
     if jpath is None:
         from paddle_tpu.observability.journal import journal_path
@@ -242,10 +416,12 @@ def main(argv=None) -> int:
     elif args.live:
         from paddle_tpu.observability.export import to_dict
         snapshot = to_dict()
-    if events is None and snapshot is None:
-        ap.error("nothing to report: pass --journal and/or --metrics "
-                 "(or --live), or run with PADDLE_TPU_OBS=1 first")
-    print(render_report(events, snapshot))
+    if args.trace:
+        trace_events = load_trace(args.trace)
+    if events is None and snapshot is None and trace_events is None:
+        ap.error("nothing to report: pass --journal, --metrics and/or "
+                 "--trace (or --live), or run with PADDLE_TPU_OBS=1 first")
+    print(render_report(events, snapshot, trace_events))
     return 0
 
 
