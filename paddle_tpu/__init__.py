@@ -37,6 +37,7 @@ from . import io  # noqa: F401
 from . import contrib  # noqa: F401
 from . import flags  # noqa: F401
 from . import observability  # noqa: F401
+from . import analysis  # noqa: F401  (static program verifier)
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
 from . import average  # noqa: F401

@@ -1,0 +1,88 @@
+"""Static program analysis: verify a Program before the first XLA compile.
+
+The reference framework validated programs only while interpreting them
+op-by-op (operator.cc enforce macros, executor.cc:94 run loop) -- a
+malformed program died mid-run with a C++ stack. Here the whole static
+Program is linted *ahead of time*, the way tensor-IR compilers legalize
+before codegen:
+
+    import paddle_tpu.analysis as analysis
+    diags = analysis.verify(main_program, fetch_names=["loss"])
+    errors = [d for d in diags if d.severity == "error"]
+
+Findings carry stable ``PT0xx`` codes (diagnostics.CODES is the table),
+severities (error/warn/info), and the op's user-code creation stack
+(Operator._creation_stack) so every finding points at the model line that
+built the offending op.
+
+Three doors in:
+
+- library: ``analysis.verify(program) -> [Diagnostic]`` (this module);
+- CLI: ``python -m paddle_tpu.analysis program.json --format json`` /
+  ``tools/lint_program.py`` over a serialized Program;
+- executor gate: ``PADDLE_TPU_VALIDATE=off|warn|raise`` verifies once per
+  compile-cache miss and journals findings through observability.
+
+Passes (pass_base registry, the ir::Pass analog): ``wellformed``
+(undefined/use-before-def vars, unregistered ops, block-graph sanity),
+``dataflow`` (dead ops, WAW hazards, fetch reachability), ``typecheck``
+(shape/dtype propagation vs declarations), ``recompile`` (compile-cache
+churn risks).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..framework import Program
+from . import dataflow  # noqa: F401  (registers the pass)
+from . import recompile  # noqa: F401
+from . import typecheck  # noqa: F401
+from . import wellformed  # noqa: F401
+from .diagnostics import (CODES, Diagnostic, Severity,  # noqa: F401
+                          codes_table, count_by_severity,
+                          format_diagnostics, sort_diagnostics)
+from .pass_base import (AnalysisPass, PassContext,  # noqa: F401
+                        default_passes, get_pass, register_pass,
+                        registered_passes, run_passes)
+
+
+class VerificationError(RuntimeError):
+    """Raised by verify_or_raise / PADDLE_TPU_VALIDATE=raise: the program
+    has error-severity findings. ``diagnostics`` holds every finding."""
+
+    def __init__(self, message: str, diagnostics: List[Diagnostic]):
+        super().__init__(message)
+        self.diagnostics = diagnostics
+
+
+def verify(program: Program,
+           feed_names: Optional[Sequence[str]] = None,
+           fetch_names: Optional[Sequence[str]] = None,
+           passes: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Run the analysis pipeline over ``program``; return sorted findings.
+
+    ``feed_names``/``fetch_names`` sharpen the analysis when the run intent
+    is known (Executor.run passes both): fetch targets switch on dead-op
+    liveness and fetch-reachability, feeds tighten the unread-feed check.
+    Without them the checks degrade gracefully (is_data vars are assumed
+    feedable, liveness is skipped).
+    """
+    return sort_diagnostics(run_passes(program, passes=passes,
+                                       feed_names=feed_names,
+                                       fetch_names=fetch_names))
+
+
+def verify_or_raise(program: Program,
+                    feed_names: Optional[Sequence[str]] = None,
+                    fetch_names: Optional[Sequence[str]] = None,
+                    passes: Optional[Sequence[str]] = None
+                    ) -> List[Diagnostic]:
+    """verify(), raising VerificationError if any error-severity finding."""
+    diags = verify(program, feed_names=feed_names, fetch_names=fetch_names,
+                   passes=passes)
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    if errors:
+        raise VerificationError(
+            "program verification failed:\n" +
+            format_diagnostics(errors, with_stack=True), diags)
+    return diags

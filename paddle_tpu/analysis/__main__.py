@@ -1,0 +1,192 @@
+"""CLI: lint a serialized Program (``Program.to_json`` output).
+
+    python -m paddle_tpu.analysis prog.json [--fetch loss] [--feed img]
+    python -m paddle_tpu.analysis --codes        # diagnostic-code table
+    python -m paddle_tpu.analysis --selftest     # pinned by the test suite
+
+``tools/lint_program.py`` is the same entry point addressable without the
+package on sys.path. Exit status: 0 clean (below the --fail-on bar), 1
+findings at/above the bar, 2 usage/load errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ..framework import Program
+from . import (CODES, Severity, codes_table, count_by_severity,
+               format_diagnostics, registered_passes, verify)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="Static verifier/linter for paddle_tpu Programs")
+    ap.add_argument("program", nargs="?",
+                    help="path to a Program JSON file (Program.to_json), "
+                         "or '-' for stdin")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format (default text)")
+    ap.add_argument("--fetch", action="append", default=None,
+                    metavar="NAME", help="fetch target (repeatable); "
+                    "enables dead-op/reachability analysis")
+    ap.add_argument("--feed", action="append", default=None, metavar="NAME",
+                    help="feed var name (repeatable)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset "
+                         f"(default: all of {registered_passes()})")
+    ap.add_argument("--fail-on", choices=("error", "warn", "never"),
+                    default="error",
+                    help="exit 1 when findings at/above this severity "
+                         "exist (default error)")
+    ap.add_argument("--no-stack", action="store_true",
+                    help="omit op creation stacks from text output")
+    ap.add_argument("--codes", action="store_true",
+                    help="print the diagnostic-code table and exit")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in end-to-end check and exit")
+    return ap
+
+
+def _load_program(path: str) -> Program:
+    data = sys.stdin.read() if path == "-" else open(path).read()
+    return Program.from_json(data)
+
+
+def _emit(diags, args) -> None:
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [d.to_dict() for d in diags],
+            "counts": count_by_severity(diags),
+        }, indent=2, sort_keys=True))
+    else:
+        print(format_diagnostics(diags, with_stack=not args.no_stack))
+
+
+def _exit_code(diags, fail_on: str) -> int:
+    if fail_on == "never":
+        return 0
+    bad = {Severity.ERROR} if fail_on == "error" else \
+        {Severity.ERROR, Severity.WARN}
+    return 1 if any(d.severity in bad for d in diags) else 0
+
+
+# ---------------------------------------------------------------- selftest --
+
+def _selftest() -> int:
+    """Build minimal trigger programs in-process and pin the expected codes
+    (the CI analog of obs_report --selftest)."""
+    failures: List[str] = []
+
+    def expect(tag: str, diags, *, has=(), lacks=(), no_errors=False):
+        codes = {d.code for d in diags}
+        for c in has:
+            if c not in codes:
+                failures.append(f"{tag}: expected {c}, got {sorted(codes)}")
+        for c in lacks:
+            if c in codes:
+                failures.append(f"{tag}: unexpected {c}")
+        if no_errors and any(d.severity == Severity.ERROR for d in diags):
+            failures.append(
+                f"{tag}: unexpected errors: "
+                + "; ".join(d.format() for d in diags
+                            if d.severity == Severity.ERROR))
+
+    # clean single-op program: x(data) -> relu -> y, fetched
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (-1, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    expect("clean", verify(p, fetch_names=["y"]), no_errors=True,
+           lacks=("PT001", "PT004", "PT012"))
+
+    # undefined input var + unregistered op type
+    p = Program()
+    b = p.global_block()
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    b.append_op("definitely_not_an_op", inputs={}, outputs={"Out": ["z"]},
+                infer_shape=False)
+    expect("undefined/unregistered", verify(p), has=("PT001", "PT004"))
+
+    # write-after-write, no read between
+    p = Program()
+    b = p.global_block()
+    b.append_op("fill_constant", outputs={"Out": ["c"]},
+                attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+    b.append_op("fill_constant", outputs={"Out": ["c"]},
+                attrs={"shape": [2], "dtype": "float32", "value": 2.0})
+    expect("waw", verify(p, fetch_names=["c"]), has=("PT013",))
+
+    # declared dtype disagrees with inference
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.create_var("y", (4,), "int32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    expect("dtype clash", verify(p), has=("PT020",))
+
+    # dynamic non-batch dim on a feed
+    p = Program()
+    b = p.global_block()
+    b.create_var("seq", (-1, -1, 8), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["seq"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    expect("recompile risk", verify(p), has=("PT030",))
+
+    # serialization round trip reports identical findings
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (-1, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    b.append_op("definitely_not_an_op", inputs={"X": ["y"]},
+                outputs={"Out": ["z"]}, infer_shape=False)
+    d1 = verify(p, fetch_names=["z"])
+    d2 = verify(Program.from_json(p.to_json()), fetch_names=["z"])
+    if [d.key() for d in d1] != [d.key() for d in d2]:
+        failures.append("round-trip: diagnostics differ:\n"
+                        f"{[d.key() for d in d1]}\nvs\n"
+                        f"{[d.key() for d in d2]}")
+
+    if failures:
+        print("selftest: FAILED")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print(f"selftest: OK ({len(CODES)} codes registered, "
+          f"passes: {', '.join(registered_passes())})")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if args.codes:
+        print(codes_table())
+        return 0
+    if args.selftest:
+        return _selftest()
+    if not args.program:
+        build_arg_parser().print_usage()
+        print("error: need a program JSON path (or --codes/--selftest)")
+        return 2
+    try:
+        program = _load_program(args.program)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: cannot load program from {args.program!r}: {e}")
+        return 2
+    passes = args.passes.split(",") if args.passes else None
+    try:
+        diags = verify(program, feed_names=args.feed,
+                       fetch_names=args.fetch, passes=passes)
+    except KeyError as e:
+        print(f"error: {e}")
+        return 2
+    _emit(diags, args)
+    return _exit_code(diags, args.fail_on)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,10 +15,13 @@ f32. Parameters stay f32 master copies; the per-use cast ops are folded by XLA.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Set, Tuple
+from typing import List, Set, Tuple
 
 from .. import unique_name
-from ..framework import Program, Variable, default_main_program, is_float_dtype
+from ..framework import Program, is_float_dtype
+# re-exported surface (tests/api_spec.txt): ported AMP user code reaches
+# these through this module
+from ..framework import Variable, default_main_program  # noqa: F401
 
 
 class AutoMixedPrecisionLists:

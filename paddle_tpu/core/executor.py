@@ -249,6 +249,74 @@ class Executor:
         # last compile-key components per Program, for the recompile detector
         # (entries pin the Program like _cache does, same LRU bound)
         self._key_parts: Dict[int, Tuple[Program, dict]] = {}
+        # (program id, version, feed names, fetch names) -> (program, diags)
+        # memo for the PADDLE_TPU_VALIDATE gate: the verifier runs at most
+        # once per compile-cache miss, and not again for further misses of
+        # the same program version with the same run intent (new feed
+        # SHAPES recompile but can't change a static verdict; new feed or
+        # fetch NAMES can -- PT010/PT012/PT015 depend on them -- so they
+        # key the memo). The diags are kept so raise-mode can re-apply its
+        # policy on retries of a failing program.
+        self._verified: Dict[Tuple, Tuple[Program, list]] = {}
+
+    def _maybe_verify(self, program: Program, feed_names, fetch_names):
+        """PADDLE_TPU_VALIDATE=off|warn|raise gate, called only at compile
+        cache-miss time (default off: unset costs one os.environ read per
+        MISS, zero per warm step). Findings go to the journal/metrics
+        either way; 'warn' prints them, 'raise' aborts on errors before
+        the XLA compile is attempted."""
+        # shared off|warn|raise parser (observability.journal.mode_env,
+        # also behind PADDLE_TPU_OBS_HEALTH): toggle spellings work, typos
+        # ('rasie', 'error') raise instead of silently degrading
+        mode = _obs_journal.mode_env("PADDLE_TPU_VALIDATE")
+        if mode == "off":
+            return
+        from .. import analysis
+        vkey = (id(program), program._version,
+                tuple(sorted(feed_names)), tuple(fetch_names))
+        prev = self._verified.get(vkey)
+        if prev is not None and prev[0] is program:
+            # already verified this program version under this run intent
+            # (a new feed shape is a new compile miss but the same static
+            # program). A failing program never fills the compile cache,
+            # so every retry lands here: re-apply the raise policy from
+            # the memoized findings instead of silently letting the broken
+            # program reach trace.
+            diags = prev[1]
+            counts = analysis.count_by_severity(diags)
+        else:
+            diags = analysis.verify(program, feed_names=feed_names,
+                                    fetch_names=fetch_names)
+            self._verified[vkey] = (program, diags)
+            while len(self._verified) > self._CACHE_CAP:
+                self._verified.pop(next(iter(self._verified)))
+            counts = analysis.count_by_severity(diags)
+            for sev, n in counts.items():
+                if n:
+                    _OBS.counter("verifier_findings_total",
+                                 "static-analysis findings by severity",
+                                 severity=sev).inc(n)
+            _obs_journal.emit({
+                "event": "verify", "program": id(program),
+                "version": program._version, "mode": mode, **counts,
+                "findings": [d.to_dict() for d in diags[:50]],
+            })
+        errors = [d for d in diags
+                  if d.severity == analysis.Severity.ERROR]
+        if mode == "raise" and errors:
+            raise analysis.VerificationError(
+                f"program verification failed "
+                f"(PADDLE_TPU_VALIDATE=raise):\n" +
+                analysis.format_diagnostics(errors, with_stack=True),
+                diags)
+        if counts["error"] or counts["warn"]:  # info stays journal-only
+            import warnings
+            warnings.warn(
+                f"paddle_tpu verifier: {counts['error']} error(s), "
+                f"{counts['warn']} warning(s) in program "
+                f"{id(program)}:v{program._version}:\n" +
+                analysis.format_diagnostics(diags, with_stack=False),
+                stacklevel=3)
 
     def _note_compile(self, program: Program, parts: dict):
         """Record this compile's key components; if the same Program compiled
@@ -404,6 +472,10 @@ class Executor:
         was_miss = compiled is None
         if was_miss:
             _cache_count("misses", "compile")
+            # opt-in static verification, before any trace/compile work so
+            # PADDLE_TPU_VALIDATE=raise fails with lint diagnostics instead
+            # of a mid-trace stack (and never runs on warm steps)
+            self._maybe_verify(program, list(feed), fetch_names)
             # recompile detector: which cache-key component changed since this
             # Program last compiled (shape = feed shapes/dtypes, flags = XLA
             # compiler options, strategy = dist strategy, plus version/
@@ -649,6 +721,7 @@ class Executor:
             _obs_anomaly.DETECTOR.retire(key)
         self._cache.clear()
         self._key_parts.clear()
+        self._verified.clear()
         for prog_id, version in {(k[0], k[1]) for k in dropped}:
             _retire_program_gauges_if_dead(prog_id, version)
 

@@ -20,7 +20,7 @@ ordered as ``set_use_var``. Override with ``set_parse_fn(line) -> tuple``.
 from __future__ import annotations
 
 import os
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional
 
 import numpy as np
 

@@ -10,11 +10,11 @@ exports through save_inference_model (then serves via inference.Predictor).
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Sequence
 
 import numpy as np
 
-from .base import VarBase, _state, guard
+from .base import VarBase, _state
 from .nn import Layer
 
 

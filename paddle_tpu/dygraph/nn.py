@@ -2,7 +2,7 @@
 Conv2D:35, Pool2D:759, FC:919, BatchNorm, Embedding, LayerNorm, ...)."""
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
@@ -12,7 +12,6 @@ from .base import VarBase, trace_op, no_grad
 
 
 def _init_array(shape, dtype, initializer, fan_in=None, seed=0):
-    import jax
     rng = np.random.RandomState(seed + abs(hash(tuple(shape))) % 100000)
     if initializer == "zeros":
         return np.zeros(shape, dtype)

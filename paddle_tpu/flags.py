@@ -9,7 +9,7 @@ CUDA-era flags are accepted for port compatibility and ignored (listed as such).
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict, Optional
 
 
 class _Flag:

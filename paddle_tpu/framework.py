@@ -17,7 +17,6 @@ Design differences from the reference (deliberate, TPU-first):
 """
 from __future__ import annotations
 
-import copy
 import json
 import os as _os
 import threading

@@ -15,7 +15,7 @@ batch for a single-executable deployment.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 import numpy as np
 

@@ -23,7 +23,10 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from .core.executor import Executor, Scope, global_scope
+from .core.executor import global_scope
+# Executor/Scope are re-exported: reference user code reaches them as
+# fluid.io.Executor / fluid.io.Scope (pinned by tests/api_spec.txt)
+from .core.executor import Executor, Scope  # noqa: F401
 from .utils import fs as _fsio
 from .framework import Parameter, Program, Variable, default_main_program
 

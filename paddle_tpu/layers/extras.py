@@ -9,9 +9,8 @@ ops/sampled_ops.py; composites reuse existing ops.
 """
 from __future__ import annotations
 
-import numpy as np
 
-from ..framework import Variable, convert_dtype, default_main_program
+from ..framework import convert_dtype, default_main_program
 from ..layer_helper import LayerHelper
 from .nn import _out, _var
 
@@ -869,7 +868,6 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     from ..initializer import Normal
     helper = LayerHelper("spectral_norm", name=name)
     h = int(weight.shape[dim])
-    import numpy as _np
     w_size = 1
     for i, s in enumerate(weight.shape):
         if i != dim:

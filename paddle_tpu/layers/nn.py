@@ -6,14 +6,14 @@ to XLA later instead of dispatching CUDA kernels.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
-from .. import unique_name
-from ..framework import Variable, convert_dtype, default_main_program
+from ..framework import convert_dtype, default_main_program
+# Variable is re-exported (star-import into paddle_tpu.layers; reference
+# user code reaches it as fluid.layers.Variable -- tests/api_spec.txt)
+from ..framework import Variable  # noqa: F401
 from ..layer_helper import LayerHelper
-from ..core import registry as _registry
 
 
 def _blk():

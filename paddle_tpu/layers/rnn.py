@@ -5,9 +5,7 @@ TPU-native: recurrences lower to lax.scan via the 'scan' op; gates are fused mat
 """
 from __future__ import annotations
 
-import numpy as np
 
-from ..layer_helper import LayerHelper
 from . import nn, tensor
 
 __all__ = ["lstm_unit", "gru_unit", "simple_lstm", "simple_gru"]

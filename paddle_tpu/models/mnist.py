@@ -3,7 +3,6 @@ unittests/dist_mnist.py)."""
 from __future__ import annotations
 
 from .. import layers
-from ..layer_helper import ParamAttr
 
 
 def mlp(img, label, hidden=(128, 64), num_classes=10):

@@ -10,7 +10,6 @@ through retinanet_detection_output. ``scale``/``levels`` shrink for tests.
 """
 from __future__ import annotations
 
-import numpy as np
 
 from .. import layers
 from ..layer_helper import ParamAttr

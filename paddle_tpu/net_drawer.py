@@ -3,7 +3,6 @@
 binary dependency: emits dot text; render externally if desired."""
 from __future__ import annotations
 
-from typing import Optional
 
 
 def draw_graph(startup_program, main_program=None, **kwargs):

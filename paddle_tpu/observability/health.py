@@ -18,29 +18,18 @@ sync, no host scan.
 """
 from __future__ import annotations
 
-import os
 import warnings
 from typing import List, Optional, Sequence, Tuple
 
-from .journal import TRUTHY as _TRUTHY
 from .journal import env_truthy as _env_truthy
+from .journal import mode_env as _mode_env
 
 MODES = ("off", "warn", "raise")
 # every sibling env var is a 0/1 toggle (PADDLE_TPU_OBS=1, ..._STATE=1), so
 # accept the same spellings here instead of aborting the first Executor.run
 # of a user who wrote PADDLE_TPU_OBS_HEALTH=1: truthy -> warn, falsy -> off
-_MODE_ALIASES = {**{t: "warn" for t in _TRUTHY},
-                 **{f: "off" for f in ("0", "false", "no", "")}}
-
-
 def mode() -> str:
-    m = os.environ.get("PADDLE_TPU_OBS_HEALTH", "off").strip().lower()
-    m = _MODE_ALIASES.get(m, m)
-    if m not in MODES:
-        raise ValueError(
-            f"PADDLE_TPU_OBS_HEALTH={m!r} invalid; use one of {MODES} "
-            f"(or a 0/1 toggle: 1 means warn)")
-    return m
+    return _mode_env("PADDLE_TPU_OBS_HEALTH", MODES)
 
 
 def include_state() -> bool:

@@ -38,8 +38,30 @@ _broken_paths = set()
 TRUTHY = ("1", "true", "yes", "on")
 
 
+#: the matching falsy spellings (unset/empty included)
+FALSY = ("0", "false", "no", "")
+
+
 def env_truthy(name: str) -> bool:
     return os.environ.get(name, "").strip().lower() in TRUTHY
+
+
+def mode_env(name: str, modes=("off", "warn", "raise")) -> str:
+    """Parse an ``off|warn|raise``-style mode env var with the shared
+    toggle spellings (TRUTHY -> "warn", FALSY -> "off"). One parser for
+    every such toggle (PADDLE_TPU_OBS_HEALTH, PADDLE_TPU_VALIDATE) so no
+    spelling is accepted by one and rejected by another; unknown values
+    raise instead of silently degrading the enforcement the user asked
+    for."""
+    raw = os.environ.get(name, "off")
+    m = raw.strip().lower()
+    m = {**{t: "warn" for t in TRUTHY},
+         **{f: "off" for f in FALSY}}.get(m, m)
+    if m not in modes:
+        raise ValueError(
+            f"{name}={raw!r} invalid; use one of {modes} "
+            f"(or a 0/1 toggle: 1 means warn)")
+    return m
 
 
 def enabled() -> bool:

@@ -12,7 +12,6 @@ row so identical initial beams don't produce duplicate candidates.
 """
 from __future__ import annotations
 
-import numpy as np
 
 from ..core.registry import register
 

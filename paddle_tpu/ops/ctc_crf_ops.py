@@ -9,7 +9,6 @@ plus explicit length vectors (SURVEY.md §5.7).
 """
 from __future__ import annotations
 
-import numpy as np
 
 from ..core.registry import register
 

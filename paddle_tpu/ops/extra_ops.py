@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.registry import register, simple_op
+from ..core.registry import register
 
 
 def _jnp():

@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import functools
 
-import numpy as np
 
 # block sizes: BM rows of the flattened [N*H*W, C] activation per grid step.
 # dtype-minor tiling wants BM % 16 == 0 (bf16 sublanes); 448 = 16*28 divides

@@ -48,7 +48,6 @@ def _infer_shape(op, block):
 @register("temporal_pipeline", infer_shape=_infer_shape)
 def temporal_pipeline(ctx, ins):
     import jax
-    import jax.numpy as jnp
 
     x = ins["X"][0]
     params = tuple(ins.get("Params", ()))

@@ -6,7 +6,7 @@ compiles to a single XLA program (reference splits this across executors/op hand
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 

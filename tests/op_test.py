@@ -14,6 +14,7 @@ import unittest
 import numpy as np
 
 import paddle_tpu as fluid
+from paddle_tpu import analysis
 
 
 class OpTest(unittest.TestCase):
@@ -23,6 +24,19 @@ class OpTest(unittest.TestCase):
         self.inputs = {}
         self.outputs = {}
         self.attrs = {}
+
+    def _assert_verifies(self, program, feed, fetch):
+        """Static-verify the harness program before running it: registry/IR
+        drift (an op losing its registration, a lowering whose inferred
+        dtype stops matching the declared var) fails here with a PT0xx
+        diagnostic instead of a mid-trace stack, across every op test."""
+        diags = analysis.verify(program, feed_names=list(feed),
+                                fetch_names=list(fetch))
+        errors = [d for d in diags if d.severity == analysis.Severity.ERROR]
+        self.assertFalse(
+            errors,
+            msg=f"{self.op_type}: program failed static verification:\n" +
+                analysis.format_diagnostics(errors))
 
     # ----------------------------------------------------------------------------------
     def _build(self, for_grad=False, grad_inputs=None):
@@ -64,6 +78,7 @@ class OpTest(unittest.TestCase):
             for (nm, arr), fetch_name in zip(entries, out_io[slot]):
                 fetch.append(fetch_name)
                 expected.append(np.asarray(arr))
+        self._assert_verifies(main, feed, fetch)
         exe = fluid.Executor()
         scope = fluid.Scope()
         with fluid.scope_guard(scope):
@@ -116,6 +131,7 @@ class OpTest(unittest.TestCase):
                                   no_grad_set=no_grad_set)
 
         grad_names = [fluid.grad_var_name(n) for n in inputs_to_check]
+        self._assert_verifies(main, feed, grad_names)
         exe = fluid.Executor()
         with fluid.scope_guard(fluid.Scope()):
             analytic = exe.run(main, feed=feed, fetch_list=grad_names)
@@ -242,6 +258,7 @@ class OpTest(unittest.TestCase):
             assert g is not None, f"no double grad flows to {n}"
         exe = fluid.Executor()
         fetch = [obj_name] + [g.name for g in second]
+        self._assert_verifies(main, feed, fetch)
         with fluid.scope_guard(fluid.Scope()):
             results = exe.run(main, feed=feed, fetch_list=fetch)
         analytic = results[1:]

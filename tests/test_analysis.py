@@ -1,0 +1,695 @@
+"""Static-analysis tests: every PT0xx code pinned by a minimal program,
+the verify() API on real model programs, the executor's PADDLE_TPU_VALIDATE
+gate (including the no-work-when-unset guard), serialization round trips,
+and the CLI (in-process + the tools/lint_program.py --selftest pin)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import Diagnostic, Severity, VerificationError
+from paddle_tpu.analysis.__main__ import main as cli_main
+from paddle_tpu.framework import Program
+from paddle_tpu.observability import journal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(diags):
+    return {d.code for d in diags}
+
+
+def errors(diags):
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+# --------------------------------------------------------------- PT0xx pins --
+
+def test_pt001_undefined_input_var():
+    p = Program()
+    p.global_block().append_op("relu", inputs={"X": ["ghost"]},
+                               outputs={"Out": ["y"]}, infer_shape=False)
+    diags = analysis.verify(p)
+    assert "PT001" in codes(diags)
+    d = next(d for d in diags if d.code == "PT001")
+    assert d.severity == "error" and d.var == "ghost" and d.op_type == "relu"
+
+
+def test_pt001_declared_but_never_produced():
+    p = Program()
+    b = p.global_block()
+    b.create_var("z", (4,), "float32")  # not is_data, not persistable
+    b.append_op("relu", inputs={"X": ["z"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    assert any(d.code == "PT001" and "declared" in d.message
+               for d in analysis.verify(p))
+
+
+def test_pt002_use_before_def():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["late"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["late"]},
+                infer_shape=False)
+    diags = analysis.verify(p)
+    assert any(d.code == "PT002" and d.var == "late" for d in diags)
+
+
+def test_pt002_self_read_of_uninitialized_var():
+    """An op reading its OWN first write (in-place on an uninitialized var)
+    is use-before-def, not 'nothing produces it'."""
+    p = Program()
+    b = p.global_block()
+    b.create_var("y", (4,), "float32")
+    b.append_op("relu", inputs={"X": ["y"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    diags = analysis.verify(p, passes=["wellformed"])
+    d = next(d for d in diags if d.var == "y")
+    assert d.code == "PT002" and "same op" in d.message
+
+
+def test_pt003_shadowed_var():
+    p = Program()
+    gb = p.global_block()
+    gb.create_var("v", (4,), "float32", is_data=True)
+    sub = p._create_block()
+    sub.create_var("v", (2,), "float32")
+    p._rollback()
+    gb.append_op("relu", inputs={"X": ["v"]}, outputs={"Out": ["y"]},
+                 attrs={"sub_block": sub.idx}, infer_shape=False)
+    assert any(d.code == "PT003" and d.var == "v"
+               for d in analysis.verify(p))
+
+
+def test_pt004_unregistered_op():
+    p = Program()
+    p.global_block().append_op("definitely_not_registered", inputs={},
+                               outputs={"Out": ["y"]}, infer_shape=False)
+    diags = analysis.verify(p)
+    assert any(d.code == "PT004" and d.severity == "error" for d in diags)
+
+
+def test_pt005_malformed_block_attr():
+    p = Program()
+    p.global_block().append_op("relu", inputs={}, outputs={"Out": ["y"]},
+                               attrs={"sub_block": 99}, infer_shape=False)
+    assert "PT005" in codes(analysis.verify(p))
+
+
+def test_pt006_sub_block_cycle():
+    p = Program()
+    sub = p._create_block()
+    p._rollback()
+    p.global_block().append_op("relu", inputs={}, outputs={"Out": ["y"]},
+                               attrs={"sub_block": sub.idx},
+                               infer_shape=False)
+    sub.append_op("relu", inputs={}, outputs={"Out": ["z"]},
+                  attrs={"sub_block": sub.idx}, infer_shape=False)
+    assert "PT006" in codes(analysis.verify(p))
+
+
+def test_pt007_orphan_block():
+    p = Program()
+    p._create_block()
+    p._rollback()
+    assert "PT007" in codes(analysis.verify(p))
+
+
+def test_pt010_dead_op_vs_fetch_targets():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["z"]})
+    diags = analysis.verify(p, fetch_names=["y"])
+    dead = [d for d in diags if d.code == "PT010"]
+    assert len(dead) == 1 and dead[0].var is None and dead[0].op_idx == 1
+    # without fetch intent, liveness is unknowable: no PT010
+    assert "PT010" not in codes(analysis.verify(p))
+
+
+def test_pt011_unused_output():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    assert any(d.code == "PT011" and d.var == "y"
+               for d in analysis.verify(p))
+
+
+def test_pt012_fetch_never_produced():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    diags = analysis.verify(p, fetch_names=["nope"])
+    assert any(d.code == "PT012" and d.var == "nope" and
+               d.severity == "error" for d in diags)
+    # fetching a feed or a produced var is fine
+    assert "PT012" not in codes(analysis.verify(p, fetch_names=["y", "x"]))
+
+
+def test_pt013_write_after_write():
+    p = Program()
+    b = p.global_block()
+    b.append_op("fill_constant", outputs={"Out": ["c"]},
+                attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+    b.append_op("fill_constant", outputs={"Out": ["c"]},
+                attrs={"shape": [2], "dtype": "float32", "value": 2.0})
+    assert any(d.code == "PT013" and d.var == "c"
+               for d in analysis.verify(p, fetch_names=["c"]))
+
+
+def test_pt013_not_flagged_when_read_between():
+    p = Program()
+    b = p.global_block()
+    b.append_op("fill_constant", outputs={"Out": ["c"]},
+                attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+    b.append_op("relu", inputs={"X": ["c"]}, outputs={"Out": ["y"]})
+    b.append_op("fill_constant", outputs={"Out": ["c"]},
+                attrs={"shape": [2], "dtype": "float32", "value": 2.0})
+    assert "PT013" not in codes(analysis.verify(p, fetch_names=["c", "y"]))
+
+
+def test_pt014_in_place_read_write():
+    p = Program()
+    b = p.global_block()
+    b.append_op("fill_constant", outputs={"Out": ["c"]},
+                attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+    b.append_op("relu", inputs={"X": ["c"]}, outputs={"Out": ["c"]},
+                infer_shape=False)
+    assert any(d.code == "PT014" and d.var == "c"
+               for d in analysis.verify(p, fetch_names=["c"]))
+
+
+def test_pt015_unread_feed():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.create_var("unused", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    diags = analysis.verify(p, feed_names=["x", "unused"],
+                            fetch_names=["y"])
+    assert any(d.code == "PT015" and d.var == "unused" for d in diags)
+    assert not any(d.code == "PT015" and d.var == "x" for d in diags)
+
+
+def test_pt020_dtype_clash():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.create_var("y", (4,), "int32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    diags = analysis.verify(p)
+    assert any(d.code == "PT020" and d.severity == "error" for d in diags)
+
+
+def test_pt021_shape_clash():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.create_var("y", (3,), "float32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    assert any(d.code == "PT021" and d.var == "y"
+               for d in analysis.verify(p))
+
+
+def test_pt021_dynamic_dims_are_wildcards():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (-1, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    assert "PT021" not in codes(analysis.verify(p))
+
+
+def test_pt022_shape_inference_failure():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("reshape", inputs={"X": ["x"]}, outputs={"Out": ["y"]},
+                attrs={"shape": [3]}, infer_shape=False)  # 4 -> 3: illegal
+    assert any(d.code == "PT022" and d.op_type == "reshape"
+               for d in analysis.verify(p))
+
+
+def test_pt030_dynamic_non_batch_dim():
+    p = Program()
+    b = p.global_block()
+    b.create_var("seq", (-1, -1, 8), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["seq"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    assert any(d.code == "PT030" and d.var == "seq"
+               for d in analysis.verify(p))
+
+
+def test_pt031_dynamic_batch_dim_only():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (-1, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    diags = analysis.verify(p)
+    assert any(d.code == "PT031" and d.var == "x" for d in diags)
+    assert "PT030" not in codes(diags)
+
+
+def test_pt032_mixed_is_test():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("dropout", inputs={"X": ["x"]}, outputs={"Out": ["a"]},
+                attrs={"dropout_prob": 0.5, "is_test": False},
+                infer_shape=False)
+    b.append_op("dropout", inputs={"X": ["a"]}, outputs={"Out": ["b"]},
+                attrs={"dropout_prob": 0.5, "is_test": True},
+                infer_shape=False)
+    assert "PT032" in codes(analysis.verify(p))
+
+
+def test_pt033_stochastic_without_seed():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("dropout", inputs={"X": ["x"]}, outputs={"Out": ["a"]},
+                attrs={"dropout_prob": 0.5}, infer_shape=False)
+    assert "PT033" in codes(analysis.verify(p))
+    p.random_seed = 7
+    assert "PT033" not in codes(analysis.verify(p))
+
+
+def test_pt020_checked_despite_subblock_shadowing():
+    """A sub-block local shadowing an outer name must not suppress the
+    outer writer's dtype check (last-writer tracking is per resolved var,
+    not per bare name)."""
+    p = Program()
+    gb = p.global_block()
+    gb.create_var("x", (4,), "float32", is_data=True)
+    gb.create_var("tmp", (4,), "int32")  # clashes with relu's float32
+    gb.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["tmp"]},
+                 infer_shape=False)
+    sub = p._create_block()
+    sub.create_var("tmp", (4,), "float32")  # shadows; written later in order
+    sub.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["tmp"]},
+                  infer_shape=False)
+    p._rollback()
+    gb.append_op("relu", inputs={"X": ["tmp"]}, outputs={"Out": ["y"]},
+                 attrs={"sub_block": sub.idx}, infer_shape=False)
+    diags = analysis.verify(p, passes=["typecheck"])
+    assert any(d.code == "PT020" and d.block_idx == 0 for d in diags)
+
+
+def test_empty_fetch_list_is_no_intent_not_dead_program():
+    """fetch_names=[] (an executor run with no fetch_list) must behave like
+    None everywhere: no PT010 cascade calling every op dead."""
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    b.append_op("relu", inputs={"X": ["y"]}, outputs={"Out": ["z"]})
+    assert "PT010" not in codes(analysis.verify(p, fetch_names=[]))
+
+
+# ----------------------------------------------------- API / attribution ----
+
+def test_clean_program_has_no_findings_at_all():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (8, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    assert analysis.verify(p, feed_names=["x"], fetch_names=["y"]) == []
+
+
+def test_diagnostic_carries_creation_stack():
+    p = Program()
+    p.global_block().append_op("relu", inputs={"X": ["ghost"]},
+                               outputs={"Out": ["y"]}, infer_shape=False)
+    d = next(d for d in analysis.verify(p) if d.code == "PT001")
+    assert "test_analysis" in d.stack  # points at THIS file, not paddle_tpu
+
+
+def test_verify_or_raise():
+    p = Program()
+    p.global_block().append_op("relu", inputs={"X": ["ghost"]},
+                               outputs={"Out": ["y"]}, infer_shape=False)
+    with pytest.raises(VerificationError) as ei:
+        analysis.verify_or_raise(p)
+    assert "PT001" in str(ei.value)
+    assert any(d.code == "PT001" for d in ei.value.diagnostics)
+    ok = Program()
+    gb = ok.global_block()
+    gb.create_var("x", (4,), "float32", is_data=True)
+    gb.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    assert errors(analysis.verify_or_raise(ok, fetch_names=["y"])) == []
+
+
+def test_pass_subset_and_unknown_pass():
+    p = Program()
+    p.global_block().append_op("definitely_not_registered", inputs={},
+                               outputs={"Out": ["y"]}, infer_shape=False)
+    only_wf = analysis.verify(p, passes=["wellformed"])
+    assert "PT004" in codes(only_wf)
+    assert all(d.code.startswith("PT00") for d in only_wf)
+    with pytest.raises(KeyError):
+        analysis.verify(p, passes=["nonexistent_pass"])
+
+
+def test_diagnostics_sorted_errors_first():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (-1, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x", "ghost"]},
+                outputs={"Out": ["y"]}, infer_shape=False)
+    diags = analysis.verify(p)
+    sevs = [Severity.ORDER[d.severity] for d in diags]
+    assert sevs == sorted(sevs) and diags[0].severity == "error"
+
+
+# ----------------------------------------------- serialization round trip --
+
+def _lstm_like_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [12, 16], "float32")
+        h = fluid.layers.fc(x, 24, num_flatten_dims=2)
+        h = fluid.layers.dynamic_gru(fluid.layers.fc(
+            h, 3 * 8, num_flatten_dims=2), size=8)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    return main, startup, loss
+
+
+def test_roundtrip_clean_program_stays_clean_and_identical():
+    main, startup, loss = _lstm_like_program()
+    d1 = analysis.verify(main, feed_names=["x"], fetch_names=[loss.name])
+    assert errors(d1) == []
+    back = Program.from_dict(json.loads(json.dumps(main.to_dict())))
+    d2 = analysis.verify(back, feed_names=["x"], fetch_names=[loss.name])
+    assert [d.key() for d in d1] == [d.key() for d in d2]
+
+
+def test_roundtrip_preserves_findings_on_buggy_program():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (-1, -1, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x", "ghost"]},
+                outputs={"Out": ["y"]}, infer_shape=False)
+    b.append_op("definitely_not_registered", inputs={"X": ["y"]},
+                outputs={"Out": ["z"]}, infer_shape=False)
+    d1 = analysis.verify(p, fetch_names=["z"])
+    d2 = analysis.verify(Program.from_json(p.to_json()),
+                         fetch_names=["z"])
+    assert [d.key() for d in d1] == [d.key() for d in d2]
+    assert {"PT001", "PT004", "PT030"} <= codes(d1)
+
+
+# ------------------------------------------------- model programs verify ----
+
+def test_mnist_model_verifies_clean():
+    from paddle_tpu.models import mnist
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [1, 28, 28], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss, acc, _ = mnist.conv_net(img, label)
+        fluid.optimizer.Adam(0.001).minimize(loss)
+    d = analysis.verify(main, feed_names=["img", "label"],
+                        fetch_names=[loss.name, acc.name])
+    assert errors(d) == [], analysis.format_diagnostics(errors(d))
+    assert errors(analysis.verify(startup)) == []
+
+
+def test_rnn_scan_program_verifies_clean():
+    main, startup, loss = _lstm_like_program()
+    d = analysis.verify(main, feed_names=["x"], fetch_names=[loss.name])
+    assert errors(d) == [], analysis.format_diagnostics(errors(d))
+
+
+def test_while_loop_program_verifies_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        layers = fluid.layers
+        x = fluid.data("x", [8], "float32")
+        i = layers.fill_constant([1], "int32", 0)
+        limit = layers.fill_constant([1], "int32", 5)
+        acc = x * 0.0
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond, max_iters=5)
+        with w.block():
+            layers.assign(acc + x, acc)
+            i2 = layers.increment(i)
+            layers.less_than(i2, limit, cond=cond)
+        fetch = acc.name
+    d = analysis.verify(main, feed_names=["x"], fetch_names=[fetch])
+    assert errors(d) == [], analysis.format_diagnostics(errors(d))
+
+
+def test_detection_program_verifies_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xm = fluid.data("xm", [8, 8, 8], "float32")
+        gt_box = fluid.data("gt_box", [4, 4], "float32")
+        gt_label = fluid.data("gt_label", [4], "int32")
+        yl = fluid.layers.yolov3_loss(
+            x=fluid.layers.conv2d(xm, 3 * (5 + 2), 1),
+            gt_box=gt_box, gt_label=gt_label,
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=2, ignore_thresh=0.5, downsample_ratio=4)
+        loss = fluid.layers.mean(yl)
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    d = analysis.verify(main, feed_names=["xm", "gt_box", "gt_label"],
+                        fetch_names=[loss.name])
+    assert errors(d) == [], analysis.format_diagnostics(errors(d))
+    assert errors(analysis.verify(startup)) == []
+
+
+def test_book_chapter_fit_a_line_verifies_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [13], "float32")
+        y = fluid.data("y", [1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    for prog in (main, startup):
+        d = analysis.verify(prog, feed_names=["x", "y"],
+                            fetch_names=[loss.name] if prog is main else None)
+        assert errors(d) == [], analysis.format_diagnostics(errors(d))
+    # the for_test clone and the executor's fetch-prune rewrite stay clean
+    clone = main.clone(for_test=True)
+    assert errors(analysis.verify(clone, fetch_names=[loss.name])) == []
+    pruned = main._prune(["x", "y"], [loss.name])
+    assert errors(analysis.verify(pruned, fetch_names=[loss.name])) == []
+
+
+# ------------------------------------------------------- executor gate ------
+
+def _gate_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(y)
+    return main, startup, loss
+
+
+def _count_verify_calls(monkeypatch):
+    calls = {"n": 0}
+    real = analysis.verify
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(analysis, "verify", counting)
+    return calls
+
+
+def test_validate_unset_adds_no_per_step_work(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_VALIDATE", raising=False)
+    calls = _count_verify_calls(monkeypatch)
+    main, startup, loss = _gate_program()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[loss])
+    assert calls["n"] == 0
+    assert not journal.recent(event="verify")
+
+
+def test_validate_warn_runs_once_per_program_version(monkeypatch):
+    journal.clear()
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "warn")
+    calls = _count_verify_calls(monkeypatch)
+    main, startup, loss = _gate_program()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)  # miss 1: startup program
+        for _ in range(3):  # miss 2 (first run), then 2 hits
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[loss])
+        # a NEW feed shape is a new compile-cache miss but the same program
+        # version: must NOT re-verify
+        exe.run(main, feed={"x": np.ones((5, 4), "float32")},
+                fetch_list=[loss])
+    assert calls["n"] == 2  # startup + main, once each
+    evs = journal.recent(event="verify")
+    assert len(evs) == 2 and {e["mode"] for e in evs} == {"warn"}
+    assert all("findings" in e and "error" in e for e in evs)
+
+
+def test_validate_warn_warns_on_findings(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "warn")
+    main, startup, loss = _gate_program()
+    # append a dead op so the verifier has a warn-level finding
+    gb = main.global_block()
+    gb.append_op("relu", inputs={"X": [loss.name]},
+                 outputs={"Out": ["deadend"]})
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="PT010"):
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[loss])
+
+
+def test_validate_raise_aborts_before_compile(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "raise")
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(VerificationError, match="PT001"):
+            exe.run(p, feed={"x": np.ones((4,), "float32")},
+                    fetch_list=["y"])
+
+
+def test_validate_raise_keeps_raising_on_retries(monkeypatch):
+    """A failing program never fills the compile cache, so every retry is a
+    fresh miss: the memoized verdict must re-raise, not silently let the
+    broken program reach the trace (where it would die as a KeyError)."""
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "raise")
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    exe = fluid.Executor()
+    calls = _count_verify_calls(monkeypatch)
+    with fluid.scope_guard(fluid.Scope()):
+        for _ in range(3):
+            with pytest.raises(VerificationError):
+                exe.run(p, feed={"x": np.ones((4,), "float32")},
+                        fetch_list=["y"])
+    assert calls["n"] == 1  # verified once, policy re-applied from the memo
+
+
+def test_validate_reverifies_on_new_fetch_intent(monkeypatch):
+    """The once-per-version memo is keyed by run intent too: a changed
+    fetch list (same program version) can change the verdict (PT012), so
+    raise-mode must catch a misspelled fetch on the SECOND run as well."""
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "raise")
+    main, startup, loss = _gate_program()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[loss])  # clean intent, memoized
+        with pytest.raises(VerificationError, match="PT012"):
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=["lsss"])  # misspelled fetch, new intent
+
+
+def test_validate_rejects_unknown_mode(monkeypatch):
+    """A typo ('rasie', 'error') must fail loudly, not silently degrade to
+    warn -- same contract as PADDLE_TPU_OBS_HEALTH."""
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "rasie")
+    main, startup, loss = _gate_program()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(ValueError, match="PADDLE_TPU_VALIDATE"):
+            exe.run(startup)
+
+
+def test_validate_raise_passes_clean_program(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "raise")
+    main, startup, loss = _gate_program()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                       fetch_list=[loss])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+# ----------------------------------------------------------------- CLI ------
+
+def test_cli_json_format_on_program_file(tmp_path, capsys):
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (4,), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    f = tmp_path / "prog.json"
+    f.write_text(p.to_json())
+    rc = cli_main([str(f), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1  # errors present -> nonzero under default --fail-on
+    assert any(d["code"] == "PT001" for d in out["findings"])
+    assert out["counts"]["error"] >= 1
+
+
+def test_cli_text_format_and_exit_codes(tmp_path, capsys):
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (8, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    f = tmp_path / "clean.json"
+    f.write_text(p.to_json())
+    assert cli_main([str(f), "--fetch", "y", "--feed", "x"]) == 0
+    assert "no findings" in capsys.readouterr().out
+    # PT011 (info) alone never fails; --fail-on warn with a warn does
+    assert cli_main([str(f)]) == 0
+    capsys.readouterr()
+    b.append_op("fill_constant", outputs={"Out": ["y"]},
+                attrs={"shape": [8, 4], "dtype": "float32", "value": 0.0})
+    f.write_text(p.to_json())
+    assert cli_main([str(f), "--fetch", "y", "--fail-on", "warn"]) == 1
+    assert "PT013" in capsys.readouterr().out
+
+
+def test_cli_codes_table(capsys):
+    assert cli_main(["--codes"]) == 0
+    out = capsys.readouterr().out
+    for code in analysis.CODES:
+        assert code in out
+
+
+def test_cli_bad_input_exit_2(tmp_path, capsys):
+    assert cli_main([]) == 2
+    capsys.readouterr()
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert cli_main([str(bad)]) == 2
+
+
+@pytest.mark.smoke
+def test_lint_program_cli_selftest():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, os.path.join(
+        REPO, "tools", "lint_program.py"), "--selftest"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "selftest: OK" in r.stdout

@@ -6,6 +6,7 @@ devices, and assert the 2-process dp8 losses match the single-process dp8 run.
 Also covers the explicit shard_map GPipe schedule (parallel/pipeline.py) and
 the hierarchical (host, dp)-factored mesh helper.
 """
+import functools
 import json
 import os
 import socket
@@ -16,6 +17,41 @@ import numpy as np
 import pytest
 
 _RUNNER = os.path.join(os.path.dirname(__file__), "dist_mlp_runner.py")
+
+
+@functools.lru_cache(maxsize=1)
+def _ranks_would_run_cpu() -> bool:
+    """What backend would a spawned rank get? The rank subprocesses pop
+    JAX_PLATFORMS/XLA_FLAGS (they must see the real device plugin, not the
+    suite's forced-CPU config), so probe with the same env. jaxlib's CPU
+    backend does not implement multiprocess collectives (XlaRuntimeError:
+    "Multiprocess computations aren't implemented on the CPU backend"), so
+    on a CPU-only machine every multi-process test is unrunnable.
+
+    The probe timeout is deliberately short: a device plugin that cannot
+    even initialize within 30s (e.g. the TPU plugin probing for hardware
+    that is not attached) could not carry a multi-rank test either, so
+    timeout => skip."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            env=env, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return True
+    return r.returncode != 0 or r.stdout.strip() == "cpu"
+
+
+# the string condition is evaluated lazily (only when a marked test is
+# about to run), so plain collection / running only the unmarked tests in
+# this file never pays the jax-import subprocess probe
+requires_multiprocess_backend = pytest.mark.skipif(
+    "_ranks_would_run_cpu()",
+    reason="rank subprocesses would run on the CPU backend, which does not "
+           "implement multiprocess collectives (needs a real TPU/GPU "
+           "plugin)")
 
 
 def _free_port():
@@ -53,6 +89,7 @@ def _losses(out):
     raise AssertionError(f"no LOSSES line in output: {out[-500:]}")
 
 
+@requires_multiprocess_backend
 def test_two_process_dp_matches_single_process():
     """2 hosts x 4 devices dp8 == 1 host x 8 devices dp8, same global batch."""
     single = _losses(_launch(1, _free_port())[0])
@@ -69,6 +106,7 @@ def _tagged(out, tag):
     raise AssertionError(f"no {tag} line in output: {out[-500:]}")
 
 
+@requires_multiprocess_backend
 def test_multihost_sharded_checkpoint_reshard(tmp_path):
     """2-host dp8+ZeRO run saves per-host shard chunks; the same processes then
     load the checkpoint into a dp4xmp2 mesh and continue -- the resumed
@@ -133,6 +171,7 @@ def test_shard_batch():
     np.testing.assert_array_equal(shard_batch(x, 0, 1), x)
 
 
+@requires_multiprocess_backend
 def test_two_process_host_table_is_single_pserver():
     """host_embedding under multi-host dp: jax gathers callback operands to
     process 0 and runs the pull/push there alone — process 0's host RAM is
@@ -152,6 +191,7 @@ def test_two_process_host_table_is_single_pserver():
     assert _tagged(multi[1], "PUSHES") == 0
 
 
+@requires_multiprocess_backend
 def test_two_process_row_sharded_host_table():
     """Row-sharded host tables (SCOPE gap #1 closed): each process stores
     ONLY its row range -- the table can exceed one host's RAM -- with

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""lint_program: static-verify a serialized paddle_tpu Program.
+
+Thin launcher over ``python -m paddle_tpu.analysis`` (same flags) for
+environments that invoke tools/ scripts directly:
+
+    python tools/lint_program.py prog.json --fetch loss --format json
+    python tools/lint_program.py --codes
+    python tools/lint_program.py --selftest   # pinned by tests/test_analysis.py
+
+Serialize a program with ``open("prog.json", "w").write(program.to_json())``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

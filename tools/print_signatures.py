@@ -31,6 +31,7 @@ MODULES = [
     "paddle_tpu.contrib.slim",
     "paddle_tpu.contrib.mixed_precision",
     "paddle_tpu.contrib.quantize",
+    "paddle_tpu.analysis",
 ]
 
 
