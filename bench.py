@@ -339,6 +339,13 @@ def _parse_args(argv=None):
                          "timing is synchronous -- PADDLE_TPU_OBS=1 or the "
                          "benchmark flag) as JSON to PATH -- pairs the "
                          "BENCH_*.json throughput rounds with telemetry")
+    ap.add_argument("--tune", action="store_true",
+                    help="pre-tune the bench suites before measuring: run "
+                         "the autotuner's empirical search (Pallas-vs-XLA "
+                         "backends, flash block sizes) over the ResNet "
+                         "conv+BN and attention shapes, persist the winners "
+                         "in the decision cache, and let the bench runs "
+                         "pick them up (PADDLE_TPU_TUNE=cached default)")
     ap.add_argument("--emit-trace", metavar="PATH", default=None,
                     help="after the run, export the flight-recorder timeline "
                          "(executor feed-prep/dispatch/fetch phase spans, "
@@ -368,6 +375,13 @@ if __name__ == "__main__":
         from paddle_tpu import profiler as _prof
         _flagsmod.set_flag("profile_executor", True)
         _prof.start_profiler()
+    if _args.tune:
+        from paddle_tpu import tuning as _tuning
+        _entries = _tuning.tune_suite("all", mode="search")
+        _searched = sum(1 for e in _entries if e["source"] == "search")
+        print(f"[bench] autotune: {len(_entries)} decisions "
+              f"({_searched} newly searched) -> {_tuning.cache.CACHE.path}",
+              file=sys.stderr)
     main()
     if _args.emit_trace:
         from paddle_tpu import profiler as _prof

@@ -318,6 +318,23 @@ class Executor:
                 analysis.format_diagnostics(diags, with_stack=False),
                 stacklevel=3)
 
+    def _rehome_tuning_token(self, key, program):
+        """Move a just-compiled cache entry (and the recompile detector's
+        noted 'tuning' component) under the current decision-state token.
+        Autotune searches fire DURING the trace that built the entry, after
+        its key was computed; without the re-home the next run's key carries
+        the bumped epoch, misses, and recompiles an identical executable
+        while counting a phantom 'tuning' change."""
+        from .. import tuning as _tuning
+        new_token = _tuning.state_token()
+        if new_token != key[-1] and key in self._cache:
+            self._cache[key[:-1] + (new_token,)] = self._cache.pop(key)
+            key = key[:-1] + (new_token,)
+            held = self._key_parts.get(id(program))
+            if held is not None and held[0] is program:
+                held[1]["tuning"] = new_token
+        return key
+
     def _note_compile(self, program: Program, parts: dict):
         """Record this compile's key components; if the same Program compiled
         before under different components, count a recompile per changed
@@ -456,6 +473,21 @@ class Executor:
                 f"persistable variables {missing[:8]} are uninitialized; run the "
                 f"startup program first (exe.run(fluid.default_startup_program())).")
 
+        # Autotune decisions are consulted by op lowerings during trace (i.e.
+        # only at compile-cache-miss time); load the decision cache BEFORE
+        # building the key so state_token() is stable across this miss, and
+        # key the compiled step on (mode, cache epoch) -- a decision landing
+        # mid-process (CLI pre-tune, first search) or a PADDLE_TPU_TUNE flip
+        # must recompile affected programs, not serve a stale executable.
+        # The epoch is GLOBAL, so a new decision conservatively invalidates
+        # every program, including ones whose own consults are unchanged
+        # (they recompile to identical executables). That waste is confined
+        # to search mode while the cache warms -- in cached/off mode the
+        # epoch never moves after the one-shot load -- and is the price of
+        # never needing to track which decisions each lazy jax trace read.
+        from .. import tuning as _tuning
+        _tuning.prefetch()
+
         feed_sig = tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)
                                  if not hasattr(v, "dtype") else str(v.dtype))
                                 for k, v in feed.items()))
@@ -467,7 +499,8 @@ class Executor:
         key = (id(program), program._version, feed_sig, tuple(fetch_names), seed,
                _flagsmod.get_flag("xla_compiler_options"),
                compiled_wrapper.strategy_signature()
-               if compiled_wrapper is not None else ())
+               if compiled_wrapper is not None else (),
+               _tuning.state_token())
         compiled = self._cache.get(key)
         was_miss = compiled is None
         if was_miss:
@@ -482,7 +515,8 @@ class Executor:
             # fetches/seed)?
             self._note_compile(program, {
                 "version": key[1], "shape": key[2], "fetches": key[3],
-                "seed": key[4], "flags": key[5], "strategy": key[6]})
+                "seed": key[4], "flags": key[5], "strategy": key[6],
+                "tuning": key[7]})
             compiled = self._compile(program, list(feed), fetch_names,
                                      state_in, state_out,
                                      wrapper=compiled_wrapper)
@@ -580,6 +614,13 @@ class Executor:
             except Exception:
                 compiled.executable = None
             compiled.compile_seconds = time.perf_counter() - t0
+            # the trace above is where op lowerings consult the autotuner;
+            # searches that landed bumped the decision epoch, so re-home the
+            # cache entry (and the recompile detector's noted component)
+            # under the post-search token -- the next run sees that epoch
+            # and must HIT, not recompile an identical executable or count
+            # a phantom 'tuning' change
+            key = self._rehome_tuning_token(key, program)
             _OBS.histogram("executor_compile_seconds",
                            "trace+XLA-compile wall time per cache miss"
                            ).observe(compiled.compile_seconds)
@@ -636,6 +677,11 @@ class Executor:
                 with _phase("fetch_sync", step=step_idx, program=label):
                     jax.block_until_ready((fetches, new_state))
         run_s = time.perf_counter() - t_run
+        if was_miss and compiled.executable is None:
+            # AOT lowering unavailable: the trace (and any autotune search
+            # it triggered) ran lazily inside the first dispatch above, so
+            # the token re-home has to happen here instead
+            key = self._rehome_tuning_token(key, program)
         _OBS.histogram("executor_run_seconds",
                        "Executor.run dispatch/step wall time").observe(run_s)
         _OBS.counter("executor_runs_total", "Executor.run calls").inc()

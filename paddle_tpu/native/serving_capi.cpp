@@ -56,6 +56,14 @@ struct Predictor {
   std::vector<std::string> fetch_names;
 };
 
+// ZERO-COPY INPUT ALIASING: PyMemoryView_FromMemory does NOT copy --
+// np.frombuffer over it yields an ndarray aliasing the caller's `data`
+// pointer, and the reshape below is a view of that view. The caller's
+// buffer must therefore stay valid and unmodified until pd_predictor_run
+// returns (it does: the feed dict and every derived array are released
+// before run returns, and Predictor.run's jnp.asarray copies the bytes
+// to device before the step executes). Callers must NOT assume the
+// library retains the pointer past the call.
 PyObject* np_array_from_f32(PyObject* np, const float* data, int ndim,
                             const long long* shape) {
   long long total = 1;
@@ -68,8 +76,19 @@ PyObject* np_array_from_f32(PyObject* np, const float* data, int ndim,
   Py_DECREF(mem);
   if (flat == nullptr) return nullptr;
   PyObject* shp = PyTuple_New(ndim);
-  for (int i = 0; i < ndim; ++i)
-    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  if (shp == nullptr) {
+    Py_DECREF(flat);
+    return nullptr;
+  }
+  for (int i = 0; i < ndim; ++i) {
+    PyObject* dim = PyLong_FromLongLong(shape[i]);
+    if (dim == nullptr) {
+      Py_DECREF(flat);
+      Py_DECREF(shp);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(shp, i, dim);
+  }
   PyObject* arr = PyObject_CallMethod(flat, "reshape", "O", shp);
   Py_DECREF(flat);
   Py_DECREF(shp);
@@ -99,7 +118,9 @@ void* pd_predictor_create(const char* model_dir, const char* extra_sys_path) {
       sys = PyImport_ImportModule("sys");
       if (sys == nullptr) { set_error("import sys"); break; }
       path = PyObject_GetAttrString(sys, "path");
+      if (path == nullptr) { set_error("sys.path"); break; }
       PyObject* entry = PyUnicode_FromString(extra_sys_path);
+      if (entry == nullptr) { set_error("sys.path entry"); break; }
       PyList_Insert(path, 0, entry);
       Py_DECREF(entry);
     }

@@ -16,6 +16,7 @@ tests/long-lived processes can flip journaling at runtime.
 from __future__ import annotations
 
 import collections
+import functools
 import json
 import os
 import threading
@@ -46,21 +47,30 @@ def env_truthy(name: str) -> bool:
     return os.environ.get(name, "").strip().lower() in TRUTHY
 
 
-def mode_env(name: str, modes=("off", "warn", "raise")) -> str:
-    """Parse an ``off|warn|raise``-style mode env var with the shared
-    toggle spellings (TRUTHY -> "warn", FALSY -> "off"). One parser for
-    every such toggle (PADDLE_TPU_OBS_HEALTH, PADDLE_TPU_VALIDATE) so no
-    spelling is accepted by one and rejected by another; unknown values
-    raise instead of silently degrading the enforcement the user asked
-    for."""
-    raw = os.environ.get(name, "off")
+@functools.lru_cache(maxsize=None)
+def _mode_aliases(truthy: str) -> dict:
+    return {**{t: truthy for t in TRUTHY},
+            **{f: "off" for f in FALSY}}
+
+
+def mode_env(name: str, modes=("off", "warn", "raise"), default="off",
+             truthy="warn") -> str:
+    """Parse a mode env var with the shared toggle spellings (TRUTHY ->
+    ``truthy``, FALSY incl. empty-string -> "off", unset -> ``default``).
+    One parser for every such toggle (PADDLE_TPU_OBS_HEALTH,
+    PADDLE_TPU_VALIDATE, PADDLE_TPU_TUNE) so no spelling is accepted by one
+    and rejected by another; unknown values raise instead of silently
+    degrading the enforcement the user asked for. Called on hot paths (the
+    executor reads the tuning gate per run), hence the cached alias map."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default if default in modes else "off"
     m = raw.strip().lower()
-    m = {**{t: "warn" for t in TRUTHY},
-         **{f: "off" for f in FALSY}}.get(m, m)
+    m = _mode_aliases(truthy).get(m, m)
     if m not in modes:
         raise ValueError(
             f"{name}={raw!r} invalid; use one of {modes} "
-            f"(or a 0/1 toggle: 1 means warn)")
+            f"(or a 0/1 toggle: 1 means {truthy})")
     return m
 
 

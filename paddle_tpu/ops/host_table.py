@@ -641,7 +641,11 @@ def run_pulls(pulls, feed):
         if ids_name not in feed:
             raise KeyError(
                 f"host_lookup_table over {table_name!r}: hoisted pull needs "
-                f"ids {ids_name!r} in the feed")
+                f"ids {ids_name!r} in the feed. If this is an eval-style "
+                f"run that only fetches a sub-graph not using this lookup, "
+                f"pass use_prune=True to Executor.run so unused pulls are "
+                f"pruned away instead of demanding their ids; otherwise "
+                f"feed {ids_name!r}.")
         ids = np.asarray(feed[ids_name])
         if ids.ndim > 1 and ids.shape[-1] == 1:
             ids = ids[..., 0]            # lookup_table squeeze parity

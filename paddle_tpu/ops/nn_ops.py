@@ -38,8 +38,30 @@ def _conv_pads(pads):
     return [(pads[0], pads[0]), (pads[1], pads[1])]
 
 
-def _conv(ctx, ins, depthwise=False):
+def conv_in_layout(x, w, strides, pads, dil, groups, fmt, layout):
+    """Run a 2D conv over ``x`` (declared layout ``fmt``) *computing* in
+    ``layout``, returning the output back in ``fmt``. Filter stays OIHW in
+    every combination (parameter shapes/checkpoints are layout-independent).
+    When ``layout != fmt`` the activations are transposed at the op boundary;
+    XLA cancels adjacent inverse transposes between consecutive convs, so a
+    consistent tuned layout costs one transpose pair at the network edges."""
     lax = _lax()
+    import jax.numpy as jnp
+    if layout != fmt:
+        x = jnp.transpose(x, (0, 2, 3, 1) if fmt == "NCHW" else (0, 3, 1, 2))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=_conv_pads(pads),
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=(layout, "OIHW", layout),
+        preferred_element_type=None)
+    if layout != fmt:
+        out = jnp.transpose(out,
+                            (0, 3, 1, 2) if fmt == "NCHW" else (0, 2, 3, 1))
+    return out
+
+
+def _conv(ctx, ins, depthwise=False):
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(ctx.attr("strides", [1, 1]))
     pads = _pair(ctx.attr("paddings", [0, 0]))  # 2-elem symmetric or 4-elem
@@ -52,12 +74,19 @@ def _conv(ctx, ins, depthwise=False):
     fmt = ctx.attr("data_format", "NCHW") or "NCHW"
     if depthwise:
         groups = x.shape[1] if fmt == "NCHW" else x.shape[-1]
-    out = lax.conv_general_dilated(
-        x, w, window_strides=strides,
-        padding=_conv_pads(pads),
-        rhs_dilation=dil, feature_group_count=groups,
-        dimension_numbers=(fmt, "OIHW", fmt),
-        preferred_element_type=None)
+    # The COMPUTE layout is a tunable choice point: a persisted autotune
+    # decision may run the conv in the other layout (transposing at the
+    # boundary); the default is the declared format, i.e. exactly the old
+    # lowering. Abstract (eval_shape) lowering never consults the tuner.
+    layout = fmt
+    if not ctx.abstract and len(getattr(x, "shape", ())) == 4:
+        from ..tuning import decide as _decide
+        layout = _decide("conv2d.layout", {
+            "x_shape": tuple(x.shape), "w_shape": tuple(w.shape),
+            "strides": tuple(strides), "pads": list(pads),
+            "dils": tuple(dil), "groups": groups, "fmt": fmt,
+            "dtype": str(x.dtype)})
+    out = conv_in_layout(x, w, strides, pads, dil, groups, fmt, layout)
     return {"Output": [out]}
 
 

@@ -43,7 +43,9 @@ BLK_Q = 128
 # attention wins below it (6.1 vs 7.3 ms at S=128) and flash wins above
 # (7.4 vs 10.0 ms at S=2048) -- the online-softmax tiling pays off once the
 # S x S score tile stops fitting cache-friendly shapes. impl='pallas' forces
-# the kernel regardless.
+# the kernel regardless. This crossover is now only the DEFAULT of the
+# `fused_attention.backend` tunable choice (paddle_tpu/tuning/): a persisted
+# autotune decision overrides it per (shape bucket, device).
 AUTO_PALLAS_MIN_S = 1024
 
 
@@ -84,20 +86,22 @@ def composed_attention(q, k, v, bias, scale, dropout, causal, rng):
 # --------------------------------------------------------------------------------------
 
 def _probs(q_blk, k_all, bias_row, seed_ref, iq, scale, dropout, causal):
-    """[BLK_Q, S] softmax probabilities (f32) + dropped variant for one Q block."""
+    """[block_q, S] softmax probabilities (f32) + dropped variant for one Q
+    block (block_q comes from the staged q_blk's leading dim)."""
     import jax
     import jax.numpy as jnp
     pl, pltpu = _pl()
 
+    blk_q = q_blk.shape[0]
     s = jax.lax.dot_general(
         q_blk, k_all, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale          # [BLK_Q, S]
+        preferred_element_type=jnp.float32) * scale          # [block_q, S]
     if bias_row is not None:
         s = s + bias_row.astype(jnp.float32)                 # [1,S] broadcasts
     if causal:
         S_k = s.shape[-1]
-        qi = iq * BLK_Q + jax.lax.broadcasted_iota(jnp.int32, (BLK_Q, S_k), 0)
-        ki = jax.lax.broadcasted_iota(jnp.int32, (BLK_Q, S_k), 1)
+        qi = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, S_k), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (blk_q, S_k), 1)
         s = jnp.where(ki <= qi, s, jnp.float32(-1e30))
     m = jnp.max(s, axis=-1, keepdims=True)
     e = jnp.exp(s - m)
@@ -178,10 +182,10 @@ def _bwd_kernel(scale, dropout, causal, has_bias, *refs):
     dv_ref[0] += dv_blk
 
 
-def _specs(B, H, S, D, has_bias):
+def _specs(B, H, S, D, has_bias, block_q):
     import jax.numpy as jnp
     pl, pltpu = _pl()
-    qspec = pl.BlockSpec((1, BLK_Q, D), lambda b, i: (b, i, 0),
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM)
     kvspec = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0),
                           memory_space=pltpu.VMEM)
@@ -197,13 +201,15 @@ def _specs(B, H, S, D, has_bias):
 
 import jax as _jax  # custom_vjp must wrap at def time
 
-@functools.partial(_jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, bias, seed, scale, dropout, causal, interpret):
+@functools.partial(_jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, seed, scale, dropout, causal, interpret,
+           block_q=BLK_Q):
     return _flash_fwd_impl(q, k, v, bias, seed, scale, dropout, causal,
-                           interpret)
+                           interpret, block_q)
 
 
-def _flash_fwd_impl(q, k, v, bias, seed, scale, dropout, causal, interpret):
+def _flash_fwd_impl(q, k, v, bias, seed, scale, dropout, causal, interpret,
+                    block_q):
     import jax
     import jax.numpy as jnp
     pl, pltpu = _pl()
@@ -217,10 +223,10 @@ def _flash_fwd_impl(q, k, v, bias, seed, scale, dropout, causal, interpret):
     if has_bias:
         args.append(bias.reshape(B, 1, S))
     args.append(jnp.asarray(seed, jnp.int32).reshape(1))
-    qspec, _, in_specs = _specs(B, H, S, D, has_bias)
+    qspec, _, in_specs = _specs(B, H, S, D, has_bias, block_q)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, scale, dropout, causal, has_bias),
-        grid=(BH, S // BLK_Q),
+        grid=(BH, S // block_q),
         in_specs=in_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -229,13 +235,14 @@ def _flash_fwd_impl(q, k, v, bias, seed, scale, dropout, causal, interpret):
     return out.reshape(B, H, S, D)
 
 
-def _flash_fwd(q, k, v, bias, seed, scale, dropout, causal, interpret):
+def _flash_fwd(q, k, v, bias, seed, scale, dropout, causal, interpret,
+               block_q=BLK_Q):
     out = _flash_fwd_impl(q, k, v, bias, seed, scale, dropout, causal,
-                          interpret)
+                          interpret, block_q)
     return out, (q, k, v, bias, seed)
 
 
-def _flash_bwd(scale, dropout, causal, interpret, res, g):
+def _flash_bwd(scale, dropout, causal, interpret, block_q, res, g):
     import jax
     import jax.numpy as jnp
     pl, pltpu = _pl()
@@ -248,11 +255,11 @@ def _flash_bwd(scale, dropout, causal, interpret, res, g):
         args.append(bias.reshape(B, 1, S))
     args.append(jnp.asarray(seed, jnp.int32).reshape(1))
     args.append(g.reshape(BH, S, D))
-    qspec, kvspec, in_specs = _specs(B, H, S, D, has_bias)
+    qspec, kvspec, in_specs = _specs(B, H, S, D, has_bias, block_q)
     in_specs.append(qspec)  # do
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, scale, dropout, causal, has_bias),
-        grid=(BH, S // BLK_Q),
+        grid=(BH, S // block_q),
         in_specs=in_specs,
         out_specs=[qspec, kvspec, kvspec],
         out_shape=[
@@ -365,13 +372,23 @@ def fused_attention(ctx, ins):
             f"[B,1,1,S] bias, and (for dropout>0) a real TPU; got S={S}, "
             f"bias={bias_shape}, dropout={dropout}, backend_tpu={is_tpu}. "
             f"Use impl='auto' to fall back to the composed lowering.")
+    # impl='auto' backend + block sizes are tunable choice points: with a
+    # persisted autotune decision (PADDLE_TPU_TUNE=cached/search) the
+    # measured winner is used; without one the default reproduces the
+    # static S >= AUTO_PALLAS_MIN_S crossover and BLK_Q exactly.
+    from ..tuning import decide as _decide
+    tune_params = {"b": B, "h": H, "s": S, "d": D, "dtype": str(q.dtype),
+                   "has_bias": bias is not None, "dropout": float(dropout),
+                   "causal": causal, "scale": float(scale)}
     use_pallas = impl == "pallas" or (
-        impl == "auto" and S >= AUTO_PALLAS_MIN_S and
-        supports_pallas(B, H, S, D, bias_shape, dropout, is_tpu))
+        impl == "auto" and
+        supports_pallas(B, H, S, D, bias_shape, dropout, is_tpu) and
+        _decide("fused_attention.backend", tune_params) == "pallas")
     if use_pallas:
+        block_q, _ = _decide("fused_attention.block_sizes", tune_params)
         seed = jax.random.randint(ctx.rng(), (), 0, 2**31 - 1, jnp.int32)
         out = _flash(q, k, v, bias, seed, float(scale), float(dropout), causal,
-                     not is_tpu)
+                     not is_tpu, block_q)
     else:
         out = composed_attention(q, k, v, bias, float(scale), float(dropout),
                                  causal, ctx.rng())

@@ -31,6 +31,11 @@ the default batch_norm lowering keeps the XLA path. The kernel stays as an
 opt-in (`layers.batch_norm(..., fuse_stats=True)` + the fuse_conv_bn
 program rewrite) so the comparison is reproducible and the fusion is
 available should a future Mosaic release shift the balance.
+
+Pallas-vs-XLA for the fused op is the `conv2d_bn_fused.backend` tunable
+choice (paddle_tpu/tuning/): `PADDLE_TPU_TUNE=search` re-derives the table
+above by measurement on the attached device and persists the per-shape
+winner; the default (no decision) keeps the historical behavior.
 """
 from __future__ import annotations
 
@@ -268,20 +273,35 @@ def conv2d_bn_fused(ctx, ins):
                 "SavedMean": [sg(mean_in)], "SavedVariance": [sg(inv)]}
 
     is_tpu = jax.default_backend() == "tpu"
-    if supports_fused(M, C, O) and not ctx.abstract:
+    # Pallas-vs-XLA is a tunable choice point: a persisted autotune decision
+    # (PADDLE_TPU_TUNE=cached/search) picks the measured winner per shape
+    # bucket; the default keeps the pre-autotuner behavior (Pallas whenever
+    # the shape gate admits it). Abstract (eval_shape) lowering always takes
+    # the XLA formulation -- same shapes/dtypes, no kernel launch.
+    if ctx.abstract or not supports_fused(M, C, O):
+        backend = "xla"
+    else:
+        from ..tuning import decide as _decide
+        backend = _decide("conv2d_bn_fused.backend",
+                          {"m": M, "k": C, "n": O, "dtype": str(x.dtype)})
+    if backend == "pallas":
         dummy = jnp.zeros((C,), jnp.float32)
         y2, s, ss = fused_conv1x1_bn(
             x2, w2, dummy, jnp.ones((C,), jnp.float32), dummy, dummy,
             eps, False, False, not is_tpu)
         mean = s / M
         var = ss / M - mean * mean
-    else:  # shape outside the kernel gate: same math via XLA
+    else:  # 'xla' (and shapes outside the kernel gate): same math via XLA
         y2 = jax.lax.dot_general(x2, w2, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32
                                  ).astype(x.dtype)
         yf = y2.astype(jnp.float32)
         mean = jnp.mean(yf, axis=0)
         var = jnp.mean(yf * yf, axis=0) - mean * mean
+    # E[y^2] - E[y]^2 can cancel below -eps in low precision and NaN the
+    # rsqrt; batch variance is mathematically >= 0, so clamp (both the
+    # Pallas s/ss-derived path and the XLA fallback above reach here)
+    var = jnp.maximum(var, 0.0)
     inv = jax.lax.rsqrt(var + eps)
     out = (y2.astype(jnp.float32) - mean) * inv
     out = out * scale.astype(jnp.float32) + bias.astype(jnp.float32)

@@ -6,7 +6,11 @@ TPU-native: builds on io.save_persistables / load_persistables, so multi-host
 sharded state round-trips per-process with no gather (io.py chunked format)
 and a checkpoint saved under one mesh restores under another
 (reshard-on-load). Rotation keeps ``max_to_keep`` steps; a LATEST marker is
-written last so a crash mid-save never corrupts the resume point.
+written last so a crash mid-save never corrupts the resume point -- and
+because ``utils/fs.py`` replace() is copy-then-delete on remote stores (no
+atomic rename on object stores), restore() treats LATEST as a hint only:
+a missing/corrupt/stale marker degrades to scanning ``ckpt-*`` dirs for the
+newest step whose manifests and chunk files are all present.
 """
 from __future__ import annotations
 
@@ -83,12 +87,81 @@ class Checkpointer:
         if due_steps or due_secs:
             self.save(step)
 
+    def _is_complete(self, d: str) -> bool:
+        """True when ``d`` holds a finished save: every rank manifest the
+        save promised parses (``io._read_manifests`` -- io.py owns the
+        manifest format, so its reader is reused rather than re-implementing
+        the layout) and every chunk file they list is present.
+        ``utils/fs.py`` replace() is copy-then-delete on remote stores, so a
+        crashed save can leave any of these partially visible -- a resume
+        point must be validated, not assumed."""
+        from .. import io as _io
+        try:
+            metas = _io._read_manifests(d, None)
+            for m in metas.values():
+                for ch in m.get("chunks") or []:
+                    if not _fsio.exists(_fsio.join(d, ch["file"])):
+                        return False
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+        return True
+
+    def _complete_steps(self):
+        """Yield the steps of complete ``ckpt-*`` dirs, newest first.
+        Lazy: completeness costs one exists() per chunk file (remote stat
+        round-trips), and the caller usually wants only the newest."""
+        try:
+            names = _fsio.listdir(self.dirname)
+        except (OSError, FileNotFoundError):
+            return
+        steps = set()
+        for n in names:
+            if n.startswith("ckpt-"):
+                try:
+                    steps.add(int(n.split("-", 1)[1]))
+                except ValueError:
+                    continue
+        for s in sorted(steps, reverse=True):
+            if self._is_complete(self._step_dir(s)):
+                yield s
+
     def latest_step(self) -> int:
+        """Step of the newest *complete* checkpoint, or -1.
+
+        The LATEST pointer is the fast path; a missing, torn or corrupt
+        LATEST (or one naming an incomplete/deleted step dir -- the
+        remote-store crash window of ``fs.replace``, ADVICE r5) degrades to
+        scanning the ``ckpt-*`` dirs for the newest step whose manifests and
+        chunk files are all present.
+
+        Multi-host: rank 0 decides and broadcasts (mirroring save()'s
+        rank0-writes + barrier). Per-rank filesystem probes can race a
+        still-propagating save on an object store and disagree -- hosts
+        restoring different steps would diverge the SPMD state."""
+        import jax
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+            step = self._latest_step_local() if jax.process_index() == 0 \
+                else 0
+            return int(multihost_utils.broadcast_one_to_all(
+                np.int32(step)))
+        return self._latest_step_local()
+
+    def _latest_step_local(self) -> int:
         path = _fsio.join(self.dirname, "LATEST")
-        if not _fsio.exists(path):
-            return -1
-        with _fsio.open_file(path) as f:
-            return int(json.load(f)["step"])
+        step = None
+        try:
+            if _fsio.exists(path):
+                with _fsio.open_file(path) as f:
+                    step = int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            step = None
+        if step is not None and self._is_complete(self._step_dir(step)):
+            return step
+        for s in self._complete_steps():
+            return s
+        return -1
 
     def restore(self, program=None) -> int:
         """Load the newest complete checkpoint; returns its step or -1.

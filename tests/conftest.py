@@ -25,6 +25,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Hermetic autotuning: the default PADDLE_TPU_TUNE=cached mode consults the
+# persistent decision cache (~/.cache/paddle_tpu/autotune.json); a developer
+# machine's tuned decisions must not change which kernels the suite lowers.
+# Point the cache at a per-session temp path unless a test/env overrides it.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "PADDLE_TPU_TUNE_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"paddle_tpu_autotune_test_{os.getpid()}.json"))
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # ---------------------------------------------------------------------------
@@ -74,6 +85,9 @@ SMOKE_TESTS = {
 def pytest_configure(config):
     config.addinivalue_line("markers", "smoke: fast one-per-subsystem tier")
     config.addinivalue_line("markers", "full: everything else")
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1 (-m 'not slow'); real-device "
+                   "measurement and other long-running paths")
 
 
 def pytest_collection_modifyitems(config, items):

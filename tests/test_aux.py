@@ -152,6 +152,40 @@ def test_checkpointer_rotation_and_resume(tmp_path):
         got, = exe.run(main, feed=feed, fetch_list=[loss])
     np.testing.assert_allclose(got, ref, rtol=1e-6)
 
+    # --- LATEST-pointer tolerance (ADVICE r5: fs.replace is copy-then-
+    # delete on remote stores, so LATEST can be observed partial/corrupt
+    # after a crash; restore must scan for the newest COMPLETE step) ---
+    latest = tmp_path / "cks" / "LATEST"
+    # corrupt LATEST -> scan finds ckpt-6
+    latest.write_text("{torn jso")
+    assert Checkpointer(exe, main, d).latest_step() == 6
+    # missing LATEST -> same
+    latest.unlink()
+    assert Checkpointer(exe, main, d).latest_step() == 6
+    # LATEST names a step whose save never finished (a chunk file is
+    # missing) -> fall back to the newest complete one
+    import shutil
+    shutil.copytree(tmp_path / "cks" / "ckpt-6", tmp_path / "cks" / "ckpt-8")
+    chunks = [p for p in (tmp_path / "cks" / "ckpt-8").iterdir()
+              if p.suffix == ".npy"]
+    chunks[0].unlink()
+    latest.write_text('{"step": 8, "time": 0}')
+    ck3 = Checkpointer(exe, main, d)
+    assert ck3.latest_step() == 6
+    with fluid.scope_guard(fluid.Scope()):
+        assert ck3.restore() == 6
+        got2, = exe.run(main, feed=feed, fetch_list=[loss])
+    np.testing.assert_allclose(got2, ref, rtol=1e-6)
+    # LATEST names a rotated-away dir -> scan again
+    latest.write_text('{"step": 2, "time": 0}')
+    assert Checkpointer(exe, main, d).latest_step() == 6
+    # nothing complete at all -> -1
+    for p in (tmp_path / "cks").iterdir():
+        if p.is_dir():
+            (p / "__manifest__.json").unlink(missing_ok=True)
+    latest.unlink()
+    assert Checkpointer(exe, main, d).latest_step() == -1
+
 
 def test_weighted_average():
     from paddle_tpu.average import WeightedAverage

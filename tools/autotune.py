@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Launcher for the empirical autotuner CLI (``python -m paddle_tpu.tuning``).
+
+    python tools/autotune.py --suite resnet           # conv+BN roofline suite
+    python tools/autotune.py prog.json --format json  # pre-tune a Program
+    python tools/autotune.py --selftest
+
+Measures every candidate of each tunable choice point (Pallas-vs-XLA
+backends, flash block sizes, conv layouts) on the attached device and
+persists the winners in the atomic JSON decision cache that training runs
+consult under ``PADDLE_TPU_TUNE=cached`` (the default).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.tuning.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
