@@ -38,6 +38,7 @@ from . import contrib  # noqa: F401
 from . import flags  # noqa: F401
 from . import observability  # noqa: F401
 from . import analysis  # noqa: F401  (static program verifier)
+from . import resilience  # noqa: F401  (fault injection + step recovery)
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
 from . import average  # noqa: F401

@@ -33,6 +33,10 @@ from ..framework import (Program, Block, Variable, default_main_program)
 from ..observability import journal as _obs_journal
 from ..observability import timeline as _obs_timeline
 from ..observability.metrics import REGISTRY as _OBS
+# fault-injection hook points (resilience/faults.py); every call site is
+# guarded on `_rfaults._active` so the disarmed hot path costs one module
+# attribute read -- no env reads, no I/O
+from ..resilience import faults as _rfaults
 from . import registry
 from .registry import EMPTY_VAR, LowerCtx, stable_salt
 
@@ -243,6 +247,7 @@ class Executor:
     def __init__(self, place=None):
         import collections
         self.place = place
+        self._closing = False   # re-entrancy guard for signal-safe close()
         Executor._instances.add(self)
         self._cache: "collections.OrderedDict[Tuple, _CompiledStep]" = \
             collections.OrderedDict()
@@ -505,6 +510,12 @@ class Executor:
         was_miss = compiled is None
         if was_miss:
             _cache_count("misses", "compile")
+            if _rfaults._active:
+                # fault site: transient compile-time failure (nothing is
+                # cached yet, so a retry recompiles cleanly)
+                _rfaults.fire("compile",
+                              getattr(program, "_rng_run_counter", 0),
+                              program=f"{id(program)}:v{program._version}")
             # opt-in static verification, before any trace/compile work so
             # PADDLE_TPU_VALIDATE=raise fails with lint diagnostics instead
             # of a mid-trace stack (and never runs on warm steps)
@@ -646,6 +657,10 @@ class Executor:
             else compiled.fn
         cm = (_profiler.record_event(f"executor_run_v{program._version}")
               if _flags.get_flag("profile_executor") else contextlib.nullcontext())
+        if _rfaults._active:
+            # fault site: transient dispatch error / hang, injected BEFORE
+            # the launch so nothing has been donated and a retry is safe
+            _rfaults.fire("dispatch", step_idx, program=label)
         t_run = time.perf_counter()
         fallback_retraced = False
         with cm:
@@ -724,6 +739,14 @@ class Executor:
                              for n, shape, dtype in feed_sig},
                     "fetch": list(fetch_names[:n_user_fetch]),
                 })
+        if _rfaults._active:
+            # fault sites: transient fetch/d2h error or hang, and NaN/Inf
+            # corruption of named fetches/state BEFORE the scope commit --
+            # the health watchdog and the step guardian both see it
+            _rfaults.fire("fetch", step_idx, program=label)
+            fetches, new_state = _rfaults.corrupt_step(
+                step_idx, list(fetch_names), fetches, new_state,
+                program=label)
         for n, v in new_state.items():
             scope.set_var(n, v)
         from ..observability import health as _obs_health
@@ -760,16 +783,28 @@ class Executor:
         # their anomaly windows with them unconditionally, and per-program
         # gauges when no live executor caches the label anymore, so a
         # reused CPython id never inherits a dead program's telemetry and
-        # a still-running sibling executor never loses its own
-        from ..observability import anomaly as _obs_anomaly
-        dropped = list(self._cache)
-        for key in dropped:
-            _obs_anomaly.DETECTOR.retire(key)
-        self._cache.clear()
-        self._key_parts.clear()
-        self._verified.clear()
-        for prog_id, version in {(k[0], k[1]) for k in dropped}:
-            _retire_program_gauges_if_dead(prog_id, version)
+        # a still-running sibling executor never loses its own.
+        #
+        # Idempotent and signal-safe: the resilience preemption path (and a
+        # SIGTERM handler) may call close() while a close -- or a run -- is
+        # already in flight on this thread; a re-entrant call returns
+        # immediately instead of mutating the caches mid-iteration, and a
+        # second sequential close is a no-op over empty caches.
+        if self._closing:
+            return
+        self._closing = True
+        try:
+            from ..observability import anomaly as _obs_anomaly
+            dropped = list(self._cache)
+            for key in dropped:
+                _obs_anomaly.DETECTOR.retire(key)
+            self._cache.clear()
+            self._key_parts.clear()
+            self._verified.clear()
+            for prog_id, version in {(k[0], k[1]) for k in dropped}:
+                _retire_program_gauges_if_dead(prog_id, version)
+        finally:
+            self._closing = False
 
     @staticmethod
     def _prefetch_batches(batches, depth):

@@ -18,6 +18,7 @@ sync, no host scan.
 """
 from __future__ import annotations
 
+import threading
 import warnings
 from typing import List, Optional, Sequence, Tuple
 
@@ -25,6 +26,40 @@ from .journal import env_truthy as _env_truthy
 from .journal import mode_env as _mode_env
 
 MODES = ("off", "warn", "raise")
+
+# unconsumed nonfinite verdicts keyed by program label, stashed by check()
+# for the resilience StepGuardian: the guardian consumes the watchdog's
+# per-step finding (take_verdict) instead of paying a second scan, and
+# gets the state-var attribution its fetch-only scan could not see.
+# Per-program keying means concurrent guardians can neither steal nor
+# overwrite each other's findings; the dict is bounded (oldest evicted) so
+# verdicts nobody consumes cannot grow it.
+_verdict_lock = threading.Lock()
+_verdicts: dict = {}
+_VERDICT_CAP = 16
+
+
+def take_verdict(program=None):
+    """Return-and-clear the stashed nonfinite verdict
+    (``{"program", "where", "vars"}``) for ``program`` (a program label),
+    or the most recent one when ``program`` is None.  Returns None when
+    there is nothing unconsumed for that program; other programs' verdicts
+    are left in place."""
+    with _verdict_lock:
+        if program is None:
+            if not _verdicts:
+                return None
+            program = next(reversed(_verdicts))
+        return _verdicts.pop(program, None)
+
+
+def _stash_verdict(program, where, bad):
+    with _verdict_lock:
+        _verdicts.pop(program, None)   # re-insert = most recent
+        _verdicts[program] = {"program": program, "where": where,
+                              "vars": list(bad)}
+        while len(_verdicts) > _VERDICT_CAP:
+            _verdicts.pop(next(iter(_verdicts)))
 # every sibling env var is a 0/1 toggle (PADDLE_TPU_OBS=1, ..._STATE=1), so
 # accept the same spellings here instead of aborting the first Executor.run
 # of a user who wrote PADDLE_TPU_OBS_HEALTH=1: truthy -> warn, falsy -> off
@@ -99,6 +134,7 @@ def check(named: Sequence[Tuple[str, object]], program: str,
     bad = nonfinite_names(named)
     if not bad:
         return []
+    _stash_verdict(program, where, bad[:8])
     from . import journal as _journal
     from .metrics import REGISTRY
     REGISTRY.counter("tensor_nonfinite_total",

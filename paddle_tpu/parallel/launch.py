@@ -27,7 +27,8 @@ def _free_port() -> int:
 
 def launch(nproc: int, script_argv, coordinator: str = None,
            devices_per_proc: int = None, log_dir: str = None,
-           poll_interval: float = 0.5, max_restarts: int = 0):
+           poll_interval: float = 0.5, max_restarts: int = 0,
+           restart_backoff: float = 1.0, restart_backoff_max: float = 30.0):
     """Spawn ``nproc`` copies of ``script_argv``; returns exit codes.
 
     Failure handling (reference heart_beat_monitor.h:38 analog for the
@@ -51,16 +52,46 @@ def launch(nproc: int, script_argv, coordinator: str = None,
     """
     if max_restarts < 0:
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    import random
+    import time
     for attempt in range(max_restarts + 1):
         codes = _launch_once(nproc, script_argv, coordinator,
                              devices_per_proc, log_dir, poll_interval,
                              attempt)
         if all(c == 0 for c in codes) or attempt == max_restarts:
             return codes
+        # Exponential backoff with jitter between restarts: an immediate
+        # relaunch into the fault that just killed the job (a recovering
+        # coordinator, a TIME_WAIT'd port, a still-propagating checkpoint)
+        # burns restart budget for nothing, and a fleet of launchers
+        # restarting in lockstep thunders the shared store.
+        #
+        # The culprit rank: prefer a positive exit code (the rank that
+        # actually failed) over the monitor's terminations (negative) and
+        # unreaped ranks (None) -- but any non-clean rank counts, matching
+        # main()'s exit-code convention.
+        bad = [r for r, c in enumerate(codes) if c != 0]
+        culprit = next(
+            (r for r in bad if codes[r] is not None and codes[r] > 0),
+            bad[0] if bad else None)
+        from ..resilience.recovery import backoff_delay
+        delay = backoff_delay(attempt + 1, restart_backoff,
+                              restart_backoff_max, random)
+        from ..observability import journal as _journal
+        from ..observability.metrics import REGISTRY as _OBS
+        _OBS.counter("elastic_restarts_total",
+                     "whole-job elastic restarts by the launcher").inc()
+        _journal.emit({"event": "elastic_restart", "attempt": attempt + 1,
+                       "max_restarts": max_restarts,
+                       "failed_rank": culprit,
+                       "exit_codes": list(codes),
+                       "backoff_s": round(delay, 3)})
         sys.stderr.write(
-            f"[paddle_tpu.launch] attempt {attempt} failed; restarting the "
-            f"job from the latest checkpoint "
+            f"[paddle_tpu.launch] attempt {attempt} failed (rank "
+            f"{culprit if culprit is not None else '?'}); restarting the "
+            f"job from the latest checkpoint in {delay:.1f}s "
             f"({attempt + 1}/{max_restarts} restarts used)\n")
+        time.sleep(delay)
 
 
 def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
@@ -144,13 +175,17 @@ def main():
     ap.add_argument("--max_restarts", type=int, default=0,
                     help="restart the whole job up to N times on failure "
                          "(resume from your Checkpointer)")
+    ap.add_argument("--restart_backoff", type=float, default=1.0,
+                    help="base seconds between elastic restarts; doubles "
+                         "per attempt with jitter, capped at 30s")
     ap.add_argument("script", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.script:
         ap.error("no training script given")
     codes = launch(args.nproc, args.script, args.coordinator,
                    args.devices_per_proc, log_dir=args.log_dir,
-                   max_restarts=args.max_restarts)
+                   max_restarts=args.max_restarts,
+                   restart_backoff=args.restart_backoff)
     # any non-clean rank (nonzero, signal-killed => negative, unreaped =>
     # None) must fail the launch: max() would mask -11 behind a clean 0
     sys.exit(0 if all(c == 0 for c in codes) else 1)

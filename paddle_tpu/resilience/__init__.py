@@ -1,0 +1,31 @@
+"""paddle_tpu.resilience: fault injection + step-level recovery.
+
+The recovery layer between the Executor and the checkpoint/launch
+machinery (the TPU-native analog of the reference parameter-server
+checkpoint/retry stack), shipped together with the fault-injection harness
+that proves it works:
+
+- :mod:`faults` -- deterministic, seedable fault injection
+  (``PADDLE_TPU_FAULTS`` env / :func:`install`): NaN/Inf into a named
+  tensor at step N, transient exceptions at compile/dispatch/fetch/
+  checkpoint_write, artificial hangs, simulated preemption.
+- :mod:`recovery` -- :class:`StepGuardian` wrapping ``Executor.run`` with
+  nonfinite-step policy ``skip|rollback|raise``, bounded backoff-with-
+  jitter retry, a hung-step deadline (:class:`StepTimeout`), and
+  preemption-safe emergency checkpointing (:class:`Preempted`).
+- chaos CLI: ``python -m paddle_tpu.resilience`` / ``tools/chaos.py``
+  (``--selftest`` pinned by the test suite).
+
+Everything is off-by-default-cheap: with ``PADDLE_TPU_FAULTS`` unset and a
+default-configured guardian there is no per-step file I/O, no signal
+handler, no watchdog thread, and no snapshot copy (guard-tested).
+"""
+from . import faults  # noqa: F401
+from . import recovery  # noqa: F401
+from .faults import (Fault, FaultSpecError, TransientFault, active,  # noqa
+                     armed, clear, install, install_from_env, parse_spec)
+from .recovery import (Preempted, StepGuardian, StepTimeout,  # noqa
+                       clear_preemption, install_signal_handlers,
+                       is_transient, preemption_requested,
+                       request_preemption, transient_site,
+                       uninstall_signal_handlers)

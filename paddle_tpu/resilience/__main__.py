@@ -1,0 +1,241 @@
+"""Chaos CLI: run a small training workload under injected faults and
+report what the recovery layer did.
+
+    python -m paddle_tpu.resilience --steps 10 \
+        --faults "nan:step=3:var=LOSS;exc@dispatch:step=5;preempt:step=7" \
+        --policy skip --ckpt /tmp/chaos_ck
+    python -m paddle_tpu.resilience --selftest     # pinned by the tests
+
+The workload is a seeded MLP regression (``LOSS`` in a fault spec is
+substituted with the real loss tensor name).  A simulated preemption
+triggers the guardian's emergency checkpoint; unless ``--no-resume`` is
+given the CLI then restores from it (a fresh Executor, same scope) and
+finishes the remaining steps -- the end-to-end recovery story in one
+command.  The summary counts ``fault``/``retry``/``skip``/``rollback``/
+``preempt`` journal events observed during the run.
+
+Exit codes: 0 all steps completed, 1 incomplete run or error, 2 usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+
+def _build_workload(dim: int, seed: int):
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [dim], "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, dim))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def run_chaos(steps: int = 10, faults_spec: Optional[str] = None,
+              policy: str = "skip", retries: int = 3, timeout: float = 0.0,
+              ckpt_dir: Optional[str] = None, seed: int = 0, dim: int = 8,
+              batch: int = 4, resume: bool = True) -> dict:
+    """One chaos run; returns the JSON-able summary dict."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.observability import journal as _journal
+    from paddle_tpu.utils.checkpointer import Checkpointer
+
+    from . import faults as _faults
+    from . import recovery as _recovery
+
+    t0 = time.time()
+    main, startup, loss = _build_workload(dim, seed)
+    if faults_spec:
+        _faults.install(faults_spec.replace("LOSS", loss.name))
+
+    def make_feed(rs):
+        return {"x": rs.rand(batch, dim).astype("float32")}
+
+    rs = np.random.RandomState(seed)
+    scope = fluid.Scope()
+    summary = {"steps": steps, "steps_completed": 0, "policy": policy,
+               "faults_armed": _faults.describe(), "final_loss": None,
+               "preempted": None, "resumed": False}
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = (Checkpointer(exe, main, ckpt_dir) if ckpt_dir else None)
+        guardian = _recovery.StepGuardian(
+            exe, main, checkpointer=ck, nonfinite_policy=policy,
+            max_retries=retries, retry_backoff=0.01, retry_seed=seed,
+            step_timeout=timeout)
+        done, preempted = 0, None
+        try:
+            while done < steps:
+                vals = guardian.run(feed=make_feed(rs), fetch_list=[loss])
+                if vals:
+                    summary["final_loss"] = float(
+                        np.asarray(vals[0]).reshape(-1)[0])
+                done += 1
+        except _recovery.Preempted as p:
+            preempted = p
+            summary["preempted"] = {"step": p.step,
+                                    "saved_step": p.saved_step}
+        if preempted is not None and resume and ck is not None and \
+                preempted.saved_step is not None:
+            # the resumable exit, exercised end to end: new executor,
+            # restore the emergency checkpoint, finish the job
+            _recovery.clear_preemption()
+            exe2 = fluid.Executor()
+            ck2 = Checkpointer(exe2, main, ckpt_dir)
+            start = ck2.restore() + 1
+            g2 = _recovery.StepGuardian(
+                exe2, main, checkpointer=ck2, nonfinite_policy=policy,
+                max_retries=retries, retry_backoff=0.01, retry_seed=seed,
+                start_step=start)
+            summary["resumed"] = True
+            summary["resume_start_step"] = start
+            while done < steps:
+                vals = g2.run(feed=make_feed(rs), fetch_list=[loss])
+                if vals:
+                    summary["final_loss"] = float(
+                        np.asarray(vals[0]).reshape(-1)[0])
+                done += 1
+            g2.close()
+        summary["steps_completed"] = done
+        if preempted is None:
+            guardian.close()
+    events = [e for e in _journal.recent() if e.get("ts", 0) >= t0]
+    summary["events"] = {k: sum(1 for e in events if e.get("event") == k)
+                         for k in ("fault", "retry", "skip", "rollback",
+                                   "preempt", "step_timeout")}
+    return summary
+
+
+def _fmt_text(summary: dict, out=None):
+    out = out or sys.stdout
+    print(f"chaos run: {summary['steps_completed']}/{summary['steps']} "
+          f"steps completed (policy={summary['policy']})", file=out)
+    for f in summary["faults_armed"]:
+        where = f"@{f['site']}" if f["kind"] != "nan" else \
+            f":var={f['var']}"
+        step = f" step={f['step']}" if f["step"] is not None else ""
+        print(f"  armed: {f['kind']}{where}{step} "
+              f"(fired {f['fired']}/{f['times'] or 'inf'})", file=out)
+    ev = summary["events"]
+    print(f"  events: {ev['fault']} fault(s), {ev['retry']} retr(ies), "
+          f"{ev['skip']} skip(s), {ev['rollback']} rollback(s), "
+          f"{ev['preempt']} preemption(s)", file=out)
+    if summary["preempted"]:
+        p = summary["preempted"]
+        print(f"  preempted at step {p['step']} (emergency checkpoint "
+              f"step {p['saved_step']}); resumed={summary['resumed']}",
+              file=out)
+    if summary["final_loss"] is not None:
+        print(f"  final loss: {summary['final_loss']:.6g}", file=out)
+
+
+def selftest() -> int:
+    """Hermetic end-to-end self-check of the fault injector + guardian +
+    preemption-safe checkpointing; pinned by the test suite (smoke tier)."""
+    import tempfile
+
+    from . import faults as _faults
+    from . import recovery as _recovery
+
+    # 1. spec grammar round-trips
+    fs = _faults.parse_spec(
+        "nan:step=2:var=loss; exc@dispatch:step=4:times=2 ;"
+        "hang@fetch:seconds=0.2;preempt:step=6;nan:step=9:value=inf")
+    assert [f.kind for f in fs] == ["nan", "exc", "hang", "preempt", "nan"]
+    assert fs[0].site == "fetch" and fs[0].var == "loss" and fs[0].times == 1
+    assert fs[1].times == 2 and fs[1].site == "dispatch"
+    assert fs[4].value == float("inf")
+    for bogus in ("segv:step=1", "exc@nowhere", "nan:step=x",
+                  "nan:wat=1", "exc:prob=2.0"):
+        try:
+            _faults.parse_spec(bogus)
+        except _faults.FaultSpecError:
+            pass
+        else:
+            raise AssertionError(f"spec {bogus!r} should have failed")
+
+    # 2. chaos run: nonfinite skip + transient retry + preempt/resume
+    _faults.clear()
+    _recovery.clear_preemption()
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            summary = run_chaos(
+                steps=8, policy="skip", seed=7, dim=4, batch=2,
+                ckpt_dir=os.path.join(td, "ck"),
+                faults_spec="nan:step=2:var=LOSS;exc@dispatch:step=4;"
+                            "preempt:step=6")
+            assert summary["steps_completed"] == 8, summary
+            ev = summary["events"]
+            assert ev["fault"] >= 3, summary
+            assert ev["retry"] >= 1, summary
+            assert ev["skip"] == 1, summary
+            assert ev["preempt"] == 1, summary
+            assert summary["preempted"]["saved_step"] is not None, summary
+            assert summary["resumed"], summary
+            import math
+            assert summary["final_loss"] is not None and \
+                math.isfinite(summary["final_loss"]), summary
+        finally:
+            _faults.clear()
+            _recovery.clear_preemption()
+    assert not _faults.armed()
+    print("chaos selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.resilience",
+        description="chaos harness: train a small MLP under injected "
+                    "faults and report the recovery layer's behavior")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--faults", default=None,
+                    help="fault spec (see resilience.faults; LOSS is "
+                         "replaced by the workload's loss tensor name); "
+                         "default: $PADDLE_TPU_FAULTS already armed")
+    ap.add_argument("--policy", choices=("skip", "rollback", "raise"),
+                    default="skip")
+    ap.add_argument("--retries", type=int, default=3)
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="per-step deadline in seconds (0 = no watchdog)")
+    ap.add_argument("--ckpt", default=None,
+                    help="checkpoint dir (enables preemption-safe saves "
+                         "and resume)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--no-resume", action="store_true",
+                    help="do not resume after a (simulated) preemption")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    try:
+        summary = run_chaos(
+            steps=args.steps, faults_spec=args.faults, policy=args.policy,
+            retries=args.retries, timeout=args.timeout, ckpt_dir=args.ckpt,
+            seed=args.seed, dim=args.dim, batch=args.batch,
+            resume=not args.no_resume)
+    except Exception as e:  # noqa: BLE001 -- CLI boundary
+        print(f"chaos run failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(summary, indent=2, sort_keys=True, default=str))
+    else:
+        _fmt_text(summary)
+    return 0 if summary["steps_completed"] >= args.steps else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

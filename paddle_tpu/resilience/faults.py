@@ -1,0 +1,331 @@
+"""Deterministic, seedable fault-injection registry.
+
+Faults are routine at scale (a preempted host, a transient dispatch error,
+a batch that NaNs the loss); the recovery paths in ``recovery.py`` must be
+*testable* against them, which is what this module provides: a registry of
+:class:`Fault` entries armed either through the ``PADDLE_TPU_FAULTS`` env
+var (parsed once at import, so subprocess chaos tests Just Work) or through
+the :func:`install` API, fired from cheap hook points inside
+``Executor.run`` (compile / dispatch / fetch), ``Checkpointer.save``
+(checkpoint_write) and the step guardian.
+
+Spec grammar (entries separated by ``;``)::
+
+    kind[@site][:key=value]*
+
+    nan:step=3:var=loss            # overwrite tensor 'loss' with NaN at step 3
+    exc@dispatch:step=5            # transient (retryable) error at dispatch
+    exc@checkpoint_write:times=2   # first two checkpoint writes fail
+    hang@fetch:step=4:seconds=30   # artificial hang (trips the step deadline)
+    preempt:step=7                 # simulated SIGTERM (preemption flag)
+
+Kinds: ``nan`` (also ``value=inf|-inf|<float>``), ``exc``, ``hang``,
+``preempt``.  Sites: ``compile``, ``dispatch``, ``fetch``,
+``checkpoint_write`` (``nan`` ignores the site -- it corrupts the step's
+outputs/state by tensor name).  Keys: ``step`` (program step index, omit =
+every step), ``var``, ``times`` (total fires, default 1 so a rolled-back
+step does not re-trip the same fault forever; 0 = unlimited), ``seconds``
+(hang duration), ``prob`` + ``seed`` (seeded Bernoulli draw per match --
+deterministic chaos), ``value``.
+
+Every fire increments ``fault_injected_total{kind,site}`` and journals a
+``fault`` event through the observability registry.  With nothing armed the
+hot-path cost is a single module-attribute truthiness check (the executor
+guards its hook calls on ``faults._active``) -- no env reads, no I/O.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import random
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from ..observability import journal as _journal
+from ..observability.metrics import REGISTRY as _OBS
+
+ENV_VAR = "PADDLE_TPU_FAULTS"
+
+KINDS = ("nan", "exc", "hang", "preempt")
+SITES = ("compile", "dispatch", "fetch", "checkpoint_write")
+_DEFAULT_SITE = {"nan": "fetch", "exc": "dispatch", "hang": "fetch",
+                 "preempt": "dispatch"}
+
+
+class FaultSpecError(ValueError):
+    """A PADDLE_TPU_FAULTS spec string failed to parse."""
+
+
+class TransientFault(RuntimeError):
+    """The injected transient error: shaped like the retryable runtime
+    failures (its message carries the UNAVAILABLE marker) so
+    ``recovery.is_transient`` and generic marker-matching both classify it
+    correctly."""
+
+    def __init__(self, msg: str, site: str = "dispatch",
+                 step: Optional[int] = None):
+        super().__init__(msg)
+        self.site = site
+        self.step = step
+
+
+@dataclasses.dataclass
+class Fault:
+    """One armed fault. ``times`` is the total fire budget (0 = unlimited);
+    a consumed fault never fires again even if its step is replayed after a
+    rollback -- that is what makes rollback-past-a-fault terminate."""
+
+    kind: str
+    site: str
+    step: Optional[int] = None
+    var: Optional[str] = None
+    times: int = 1
+    seconds: float = 30.0
+    prob: float = 1.0
+    seed: Optional[int] = None
+    value: float = float("nan")
+    fired: int = dataclasses.field(default=0, compare=False)
+    missed: int = dataclasses.field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {self.kind!r}; use one of {KINDS}")
+        if self.site not in SITES:
+            raise FaultSpecError(
+                f"unknown fault site {self.site!r}; use one of {SITES}")
+        if not (0.0 < self.prob <= 1.0):
+            raise FaultSpecError(f"prob must be in (0, 1], got {self.prob}")
+        # per-fault seeded stream: two prob-faults never share draws, and a
+        # given (seed, match sequence) always fires at the same steps
+        self._rng = random.Random(self.seed)
+
+    def spent(self) -> bool:
+        return bool(self.times) and self.fired >= self.times
+
+    def matches(self, site: str, step: Optional[int]) -> bool:
+        if self.spent():
+            return False
+        if self.kind != "nan" and self.site != site:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        if self.prob < 1.0 and self._rng.random() >= self.prob:
+            return False
+        return True
+
+
+_INT_KEYS = ("step", "times", "seed")
+_FLOAT_KEYS = ("seconds", "prob")
+
+
+def _parse_value(v: str) -> float:
+    low = v.strip().lower()
+    if low in ("nan", "inf", "-inf"):
+        return float(low)
+    try:
+        return float(low)
+    except ValueError:
+        raise FaultSpecError(f"value={v!r} is not nan/inf/-inf or a float")
+
+
+def parse_spec(text: str) -> List[Fault]:
+    """``"nan:step=3:var=loss;exc@dispatch:step=5"`` -> [Fault, Fault]."""
+    out: List[Fault] = []
+    for raw in str(text).split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        head, _, rest = entry.partition(":")
+        head = head.strip()
+        kind, _, site = head.partition("@")
+        kind = kind.strip().lower()
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"fault entry {entry!r}: unknown kind {kind!r} "
+                f"(use one of {KINDS})")
+        site = site.strip().lower() or _DEFAULT_SITE[kind]
+        kw: Dict[str, object] = {}
+        if rest:
+            for pair in rest.split(":"):
+                pair = pair.strip()
+                if not pair:
+                    continue
+                k, eq, v = pair.partition("=")
+                k, v = k.strip().lower(), v.strip()
+                if not eq:
+                    raise FaultSpecError(
+                        f"fault entry {entry!r}: expected key=value, "
+                        f"got {pair!r}")
+                if k in _INT_KEYS:
+                    try:
+                        kw[k] = int(v)
+                    except ValueError:
+                        raise FaultSpecError(
+                            f"fault entry {entry!r}: {k}={v!r} is not an int")
+                elif k in _FLOAT_KEYS:
+                    try:
+                        kw[k] = float(v)
+                    except ValueError:
+                        raise FaultSpecError(
+                            f"fault entry {entry!r}: {k}={v!r} is not a "
+                            f"float")
+                elif k == "var":
+                    kw[k] = v
+                elif k == "value":
+                    kw[k] = _parse_value(v)
+                else:
+                    raise FaultSpecError(
+                        f"fault entry {entry!r}: unknown key {k!r} (use "
+                        f"step/var/times/seconds/prob/seed/value)")
+        out.append(Fault(kind=kind, site=site, **kw))
+    return out
+
+
+# -- registry ---------------------------------------------------------------
+
+#: armed faults; the executor/checkpointer hooks guard on plain truthiness
+#: of this list, so the disarmed hot path is one attribute read
+_active: List[Fault] = []
+
+
+def armed() -> bool:
+    return bool(_active)
+
+
+def active() -> List[Fault]:
+    return list(_active)
+
+
+def install(spec: Union[str, Fault, Sequence[Fault]]) -> List[Fault]:
+    """Arm faults from a spec string, a Fault, or a list of Faults
+    (appends to whatever is already armed; ``clear()`` resets)."""
+    if isinstance(spec, str):
+        fs = parse_spec(spec)
+    elif isinstance(spec, Fault):
+        fs = [spec]
+    else:
+        fs = list(spec)
+        for f in fs:
+            if not isinstance(f, Fault):
+                raise FaultSpecError(f"not a Fault: {f!r}")
+    _active.extend(fs)
+    return list(_active)
+
+
+def install_from_env() -> List[Fault]:
+    """(Re-)arm from ``PADDLE_TPU_FAULTS`` (no-op when unset/empty)."""
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if raw:
+        install(raw)
+    return list(_active)
+
+
+def clear():
+    del _active[:]
+
+
+def _record(f: Fault, site: str, step, program=None, var=None):
+    f.fired += 1
+    _OBS.counter("fault_injected_total", "injected faults by kind and site",
+                 kind=f.kind, site=site).inc()
+    _journal.emit({"event": "fault", "kind": f.kind, "site": site,
+                   "step": step, "var": var, "program": program})
+
+
+def fire(site: str, step: Optional[int] = None, program=None):
+    """Hook point: fire any armed exc/hang/preempt fault matching
+    ``site``/``step``. Called by Executor.run and Checkpointer.save only
+    when ``_active`` is non-empty."""
+    for f in _active:
+        if f.kind == "nan" or not f.matches(site, step):
+            continue
+        _record(f, site, step, program=program)
+        if f.kind == "preempt":
+            from . import recovery
+            recovery.request_preemption(
+                f"injected preempt fault (step {step})")
+        elif f.kind == "hang":
+            time.sleep(f.seconds)
+        else:  # exc
+            raise TransientFault(
+                f"UNAVAILABLE: injected transient fault at {site} "
+                f"(step {step})", site=site, step=step)
+
+
+def corrupt_step(step, fetch_names: Sequence[str], fetches, new_state: dict,
+                 program=None) -> Tuple[list, dict]:
+    """Hook point: apply armed ``nan`` faults to this step's outputs.
+
+    A fault whose ``var`` names a fetch overwrites the fetched value; one
+    naming a written state var overwrites the value about to be committed
+    to the Scope (so the tensor-health watchdog and the guardian's verdict
+    both see it).  ``var`` unset targets the first float fetch.  Non-float
+    targets are left alone (an int label tensor cannot hold NaN).
+    """
+    if not _active:
+        return list(fetches), new_state
+    import numpy as np
+
+    def _is_float(v):
+        try:
+            return np.issubdtype(np.asarray(v).dtype, np.floating) or \
+                "float" in str(getattr(v, "dtype", ""))
+        except Exception:
+            return False
+
+    def _corrupted(v, value):
+        arr = np.asarray(v)
+        return np.full(arr.shape, value, dtype=arr.dtype)
+
+    fetches = list(fetches)
+    for f in _active:
+        if f.kind != "nan" or not f.matches("fetch", step):
+            continue
+        target = f.var
+        if target is None:
+            target = next((n for n, v in zip(fetch_names, fetches)
+                           if _is_float(v)), None)
+        hit = False
+        if target is not None:
+            for i, n in enumerate(fetch_names):
+                if n == target and i < len(fetches) and \
+                        _is_float(fetches[i]):
+                    fetches[i] = _corrupted(fetches[i], f.value)
+                    hit = True
+            if target in new_state and _is_float(new_state[target]):
+                new_state[target] = _corrupted(new_state[target], f.value)
+                hit = True
+        if hit:
+            _record(f, "fetch", step, program=program, var=target)
+        else:
+            # the named var bound to no fetch and no written float state:
+            # a silently-vacuous injection would let a typo'd chaos spec
+            # pass without ever testing anything, so make the miss visible
+            # (journaled once per fault; the fault stays armed)
+            f.missed += 1
+            if f.missed == 1:
+                _journal.emit({
+                    "event": "fault_miss", "kind": f.kind, "step": step,
+                    "var": f.var, "program": program,
+                    "detail": "var matched no fetch or written float "
+                              "state var; fault not consumed"})
+    return fetches, new_state
+
+
+def describe() -> List[dict]:
+    """Armed faults as JSON-able dicts (chaos CLI / obs_report)."""
+    out = []
+    for f in _active:
+        d = dataclasses.asdict(f)
+        if isinstance(d.get("value"), float) and math.isnan(d["value"]):
+            d["value"] = "nan"
+        out.append(d)
+    return out
+
+
+# env arming happens once, at import (the package is imported by
+# paddle_tpu/__init__): subprocess-based chaos tests set PADDLE_TPU_FAULTS
+# and get armed faults with zero per-step env reads
+install_from_env()

@@ -61,6 +61,13 @@ class Checkpointer:
     def save(self, step: int):
         from .. import io
         from ..parallel.env import barrier
+        from ..resilience import faults as _rfaults
+        if _rfaults._active:
+            # fault site: transient checkpoint-write failure, injected
+            # before any file is touched so the guardian's retry re-runs a
+            # clean save (torn mid-write saves are separately covered by
+            # the complete-step scanning in latest_step/_is_complete)
+            _rfaults.fire("checkpoint_write", step)
         d = self._step_dir(step)
         io.save_persistables(self.exe, d, self.program)   # barriers inside
         if self._is_rank0():

@@ -79,6 +79,8 @@ SMOKE_TESTS = {
     "test_slim.py::test_structure_pruner_idx_and_tensor",
     "test_aux.py::test_chrome_trace_export",
     "test_api_spec.py::test_api_matches_spec",
+    "test_resilience.py::test_chaos_cli_selftest",
+    "test_resilience.py::test_zero_overhead_when_disabled",
 }
 
 

@@ -1,0 +1,624 @@
+"""Resilience subsystem (ISSUE 6): fault injection, step-level
+retry/rollback, hung-step deadline, preemption-safe checkpointing, chaos
+CLI -- plus the zero-overhead and byte-identical guards that pin the
+"unset env costs nothing" contract."""
+import builtins
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.observability import journal
+from paddle_tpu.observability.metrics import REGISTRY
+from paddle_tpu.resilience import (StepGuardian, faults, recovery)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_resilience():
+    """Every test starts and ends with nothing armed: no faults, no
+    preemption flag, no signal handlers."""
+    faults.clear()
+    recovery.clear_preemption()
+    yield
+    faults.clear()
+    recovery.clear_preemption()
+    recovery.uninstall_signal_handlers(force=True)
+
+
+def _counter_val(name, **labels):
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+    child = fam.children.get(key)
+    return child.value if child is not None else 0.0
+
+
+def _train_program(dim=4, seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [dim], "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, dim))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(dim=4, step=0):
+    return {"x": np.full((2, dim), 1.0 + 0.1 * step, "float32")}
+
+
+# ------------------------------------------------------------ fault specs --
+
+def test_parse_spec_grammar():
+    fs = faults.parse_spec(
+        "nan:step=3:var=loss; exc@checkpoint_write:times=2 ;"
+        "hang@fetch:step=4:seconds=0.5;preempt:step=7;"
+        "nan:step=9:value=-inf;exc@compile:prob=0.5:seed=11")
+    assert [f.kind for f in fs] == ["nan", "exc", "hang", "preempt", "nan",
+                                   "exc"]
+    # defaults: nan->fetch site, exc->dispatch, times=1
+    assert fs[0].site == "fetch" and fs[0].step == 3 and fs[0].times == 1
+    assert fs[1].site == "checkpoint_write" and fs[1].times == 2
+    assert fs[2].seconds == 0.5
+    assert fs[3].site == "dispatch"  # preempt default site
+    assert fs[4].value == float("-inf")
+    assert fs[5].prob == 0.5 and fs[5].seed == 11
+    assert np.isnan(fs[0].value)
+
+
+@pytest.mark.parametrize("bad", [
+    "segv:step=1",            # unknown kind
+    "exc@nowhere",            # unknown site
+    "nan:step=three",         # non-int step
+    "nan:wat=1",              # unknown key
+    "exc:prob=2.0",           # prob out of range
+    "nan step=3",             # missing key=value separator
+    "nan:value=banana",       # bad value literal
+])
+def test_parse_spec_errors(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(bad)
+
+
+def test_install_from_env_and_clear(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "exc@dispatch:step=0")
+    assert not faults.armed()
+    got = faults.install_from_env()
+    assert faults.armed() and got[0].kind == "exc"
+    faults.clear()
+    assert not faults.armed() and faults.active() == []
+
+
+def test_times_budget_survives_step_replay():
+    """A consumed fault never re-fires even when its step is replayed
+    (the property that makes rollback-past-a-fault terminate)."""
+    f, = faults.parse_spec("exc@dispatch:step=5")
+    assert f.matches("dispatch", 5)
+    f.fired += 1
+    assert not f.matches("dispatch", 5)
+    unlimited, = faults.parse_spec("exc@dispatch:times=0")
+    for _ in range(5):
+        assert unlimited.matches("dispatch", 1)
+        unlimited.fired += 1
+
+
+def test_seeded_prob_faults_are_deterministic():
+    draws = []
+    for _ in range(2):
+        f, = faults.parse_spec("exc@dispatch:prob=0.5:seed=123:times=0")
+        draws.append([f.matches("dispatch", s) for s in range(32)])
+    assert draws[0] == draws[1]
+    assert any(draws[0]) and not all(draws[0])
+
+
+# ------------------------------------------------- executor-level injection --
+
+def test_nan_injection_corrupts_named_fetch_once():
+    main, startup, loss = _train_program()
+    c0 = _counter_val("fault_injected_total", kind="nan", site="fetch")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        faults.install(f"nan:step=1:var={loss.name}")
+        vals = [exe.run(main, feed=_feed(), fetch_list=[loss])[0]
+                for _ in range(3)]
+    assert np.isfinite(vals[0]).all()
+    assert np.isnan(vals[1]).all()          # step 1: corrupted
+    assert np.isfinite(vals[2]).all()       # times=1: fired once only
+    assert _counter_val("fault_injected_total", kind="nan",
+                        site="fetch") == c0 + 1
+    ev = journal.recent(event="fault")[-1]
+    assert ev["kind"] == "nan" and ev["var"] == loss.name
+
+
+def test_nan_fault_miss_is_journaled_and_stays_armed():
+    """A nan fault whose var binds to no fetch/state must not vanish
+    silently: the miss is journaled (once) and the fault keeps its
+    budget, so a typo'd chaos spec cannot pass vacuously."""
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        faults.install("nan:var=not_a_real_var")
+        out, = exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert np.isfinite(out).all()
+    ev = journal.recent(event="fault_miss")[-1]
+    assert ev["var"] == "not_a_real_var"
+    f = faults.active()[0]
+    assert f.fired == 0 and f.missed >= 1 and not f.spent()
+
+
+def test_exc_injection_raises_transient_from_run():
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        faults.install("exc@dispatch:step=0")
+        with pytest.raises(faults.TransientFault) as ei:
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert recovery.is_transient(ei.value)
+        assert recovery.transient_site(ei.value) == "dispatch"
+        # the fault consumed its budget: a bare retry succeeds
+        out, = exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert np.isfinite(out).all()
+
+
+def test_env_armed_subprocess_injection():
+    """The PADDLE_TPU_FAULTS env contract: arming happens at import, no
+    API calls needed (how chaos tests drive unmodified training scripts)."""
+    code = (
+        "import numpy as np\n"
+        "import paddle_tpu as fluid\n"
+        "from paddle_tpu.resilience import faults\n"
+        "assert faults.armed(), 'env spec not armed at import'\n"
+        "main, startup = fluid.Program(), fluid.Program()\n"
+        "with fluid.unique_name.guard(), fluid.program_guard(main, startup):\n"
+        "    x = fluid.data('x', [4], 'float32')\n"
+        "    loss = fluid.layers.mean(fluid.layers.fc(x, 4))\n"
+        "with fluid.scope_guard(fluid.Scope()):\n"
+        "    exe = fluid.Executor()\n"
+        "    try:\n"
+        "        # the step key is a per-program run counter, so the\n"
+        "        # startup program's first run is also a step-0 dispatch\n"
+        "        exe.run(startup)\n"
+        "        exe.run(main, feed={'x': np.ones((2, 4), 'float32')},\n"
+        "                fetch_list=[loss])\n"
+        "    except faults.TransientFault:\n"
+        "        print('INJECTED_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_FAULTS="exc@dispatch:step=0")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert "INJECTED_OK" in r.stdout, r.stderr[-2000:]
+
+
+# ----------------------------------------------------------- the guardian --
+
+def test_guardian_retries_transient_with_backoff():
+    main, startup, loss = _train_program()
+    r0 = _counter_val("step_retries_total", site="dispatch")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, max_retries=3, retry_backoff=0.001,
+                         retry_seed=7)
+        faults.install("exc@dispatch:step=1:times=2")
+        vals = [g.run(feed=_feed(), fetch_list=[loss])[0]
+                for _ in range(3)]
+    assert all(np.isfinite(v).all() for v in vals)
+    assert _counter_val("step_retries_total", site="dispatch") == r0 + 2
+    evs = journal.recent(event="retry")[-2:]
+    assert [e["attempt"] for e in evs] == [1, 2]
+    assert all(e["site"] == "dispatch" and e["backoff_ms"] > 0
+               for e in evs)
+
+
+def test_guardian_retry_budget_exhausted():
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, max_retries=1, retry_backoff=0.001)
+        faults.install("exc@dispatch:times=0")  # permanently failing
+        with pytest.raises(faults.TransientFault):
+            g.run(feed=_feed(), fetch_list=[loss])
+
+
+def test_guardian_does_not_retry_nontransient():
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, max_retries=3, retry_backoff=0.001)
+        n_retries_before = len(journal.recent(event="retry"))
+        with pytest.raises(KeyError):
+            # undefined feed var -> trace KeyError, no transient marker:
+            # must raise immediately, not burn the retry budget
+            g.run(feed={}, fetch_list=[loss])
+    assert len(journal.recent(event="retry")) == n_retries_before
+
+
+def test_skip_policy_drops_exactly_the_bad_update():
+    main, startup, loss = _train_program()
+    wname = main.all_parameters()[0].name
+    s0 = _counter_val("steps_skipped_total")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, nonfinite_policy="skip")
+        for _ in range(2):
+            g.run(feed=_feed(), fetch_list=[loss])
+        w_before = np.array(scope.find_var(wname), copy=True)
+        faults.install(f"nan:step=2:var={loss.name}")
+        bad = g.run(feed=_feed(), fetch_list=[loss])
+        assert np.isnan(bad[0]).all()   # caller sees the bad loss...
+        w_after = np.asarray(scope.find_var(wname))
+        # ...but the update was dropped: state identical to pre-step
+        assert w_after.tobytes() == w_before.tobytes()
+        ok = g.run(feed=_feed(), fetch_list=[loss])
+        assert np.isfinite(ok[0]).all()
+        assert np.asarray(scope.find_var(wname)).tobytes() != \
+            w_before.tobytes()          # training resumed
+    assert _counter_val("steps_skipped_total") == s0 + 1
+    ev = journal.recent(event="skip")[-1]
+    assert ev["step"] == 2 and ev["source"] == "ring"
+
+
+def test_rollback_policy_restores_ring_snapshot():
+    main, startup, loss = _train_program()
+    wname = main.all_parameters()[0].name
+    r0 = _counter_val("rollback_total")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, nonfinite_policy="rollback",
+                         snapshot_interval=2, snapshot_ring=2)
+        for step in range(3):
+            g.run(feed=_feed(), fetch_list=[loss])
+            if step == 1:
+                # == the snapshot the guardian takes at the step-2 boundary
+                w_after_step1 = np.array(scope.find_var(wname), copy=True)
+        faults.install(f"nan:step=3:var={loss.name}")
+        g.run(feed=_feed(), fetch_list=[loss])
+        # rolled back to the step-2 snapshot == state after step 1
+        assert np.asarray(scope.find_var(wname)).tobytes() == \
+            w_after_step1.tobytes()
+        # rng-run counter rewound too: the replay is deterministic
+        assert main._rng_run_counter == 2
+    assert _counter_val("rollback_total") == r0 + 1
+    ev = journal.recent(event="rollback")[-1]
+    assert ev["to_step"] == 2 and ev["source"] == "ring"
+
+
+def test_rollback_falls_back_to_checkpointer(tmp_path):
+    from paddle_tpu.utils.checkpointer import Checkpointer
+    main, startup, loss = _train_program()
+    wname = main.all_parameters()[0].name
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck"))
+        g = StepGuardian(exe, main, checkpointer=ck,
+                         nonfinite_policy="rollback", handle_signals=False,
+                         snapshot_interval=100)  # one snapshot at step 0
+        for _ in range(2):
+            g.run(feed=_feed(), fetch_list=[loss])
+        ck.save(1)
+        w_saved = np.array(scope.find_var(wname), copy=True)
+        g.run(feed=_feed(), fetch_list=[loss])
+        g._ring.clear()                 # force the checkpoint fallback
+        faults.install(f"nan:step=3:var={loss.name}")
+        g.run(feed=_feed(), fetch_list=[loss])
+        assert np.asarray(scope.find_var(wname)).tobytes() == \
+            w_saved.tobytes()
+    ev = journal.recent(event="rollback")[-1]
+    assert ev["source"] == "checkpoint" and ev["to_step"] == 1
+
+
+def test_raise_policy_raises_on_nonfinite():
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main)   # default policy: raise
+        g.run(feed=_feed(), fetch_list=[loss])
+        faults.install(f"nan:step=1:var={loss.name}")
+        with pytest.raises(FloatingPointError):
+            g.run(feed=_feed(), fetch_list=[loss])
+
+
+def test_guardian_consumes_watchdog_raise_verdict(monkeypatch):
+    """PADDLE_TPU_OBS_HEALTH=raise fires inside Executor.run; the guardian
+    must catch the FloatingPointError, consume the stashed verdict, and
+    apply its policy instead of dying."""
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "raise")
+    main, startup, loss = _train_program()
+    wname = main.all_parameters()[0].name
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, nonfinite_policy="skip")
+        g.run(feed=_feed(), fetch_list=[loss])
+        w_before = np.array(scope.find_var(wname), copy=True)
+        faults.install(f"nan:step=1:var={loss.name}")
+        out = g.run(feed=_feed(), fetch_list=[loss])
+        # the real fetch values died with the watchdog's raise: the caller
+        # gets one NaN placeholder per requested fetch (unpacking-stable)
+        assert len(out) == 1 and np.isnan(out[0]).all()
+        assert np.asarray(scope.find_var(wname)).tobytes() == \
+            w_before.tobytes()
+        ev = journal.recent(event="skip")[-1]
+        assert loss.name in ev["vars"]
+        nxt = g.run(feed=_feed(), fetch_list=[loss])
+        assert np.isfinite(nxt[0]).all()
+
+
+def test_step_timeout_deadlines_hung_step():
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, step_timeout=0.5)
+        g.run(feed=_feed(), fetch_list=[loss])   # compile outside the hang
+        faults.install("hang@fetch:seconds=30")
+        t0 = time.time()
+        with pytest.raises(recovery.StepTimeout):
+            g.run(feed=_feed(), fetch_list=[loss])
+        assert time.time() - t0 < 5, "deadline did not fire"
+        assert not recovery.is_transient(recovery.StepTimeout("x"))
+    assert journal.recent(event="step_timeout")[-1]["deadline_s"] == 0.5
+
+
+def test_preemption_via_real_signal(tmp_path):
+    from paddle_tpu.utils.checkpointer import Checkpointer
+    main, startup, loss = _train_program()
+    orig_term = signal.getsignal(signal.SIGTERM)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck"))
+        g = StepGuardian(exe, main, checkpointer=ck)  # handlers auto-on
+        assert signal.getsignal(signal.SIGTERM) is not orig_term
+        for _ in range(3):
+            g.run(feed=_feed(), fetch_list=[loss])
+        signal.raise_signal(signal.SIGTERM)          # delivered in-process
+        assert recovery.preemption_requested()
+        with pytest.raises(recovery.Preempted) as ei:
+            g.run(feed=_feed(), fetch_list=[loss])
+    assert ei.value.saved_step == 2
+    assert ck.latest_step() == 2
+    # handlers restored by the guardian's close
+    assert signal.getsignal(signal.SIGTERM) is orig_term
+    ev = journal.recent(event="preempt")[-1]
+    assert ev["saved_step"] == 2 and "signal" in ev["reason"]
+    p0 = _counter_val("preemption_saves_total")
+    assert p0 >= 1
+
+
+def test_simulated_preempt_fault_and_resume(tmp_path):
+    from paddle_tpu.utils.checkpointer import Checkpointer
+    main, startup, loss = _train_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck"))
+        g = StepGuardian(exe, main, checkpointer=ck,
+                         handle_signals=False)
+        faults.install("preempt:step=1")
+        done = 0
+        with pytest.raises(recovery.Preempted):
+            while done < 5:
+                g.run(feed=_feed(), fetch_list=[loss])
+                done += 1
+        assert done == 2    # steps 0 and 1 ran; boundary of 2 preempted
+        # resume exactly where the emergency save left off
+        recovery.clear_preemption()
+        exe2 = fluid.Executor()
+        ck2 = Checkpointer(exe2, main, str(tmp_path / "ck"))
+        start = ck2.restore() + 1
+        assert start == 2
+        g2 = StepGuardian(exe2, main, checkpointer=ck2, start_step=start,
+                          handle_signals=False)
+        while done < 5:
+            out = g2.run(feed=_feed(), fetch_list=[loss])
+            done += 1
+        g2.close()
+        assert np.isfinite(out[0]).all()
+
+
+def test_checkpoint_write_fault_is_retried(tmp_path):
+    from paddle_tpu.utils.checkpointer import Checkpointer
+    main, startup, loss = _train_program()
+    c0 = _counter_val("step_retries_total", site="checkpoint_write")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck"),
+                          save_interval_steps=2)
+        g = StepGuardian(exe, main, checkpointer=ck, handle_signals=False,
+                         retry_backoff=0.001)
+        faults.install("exc@checkpoint_write:times=1")
+        for _ in range(3):
+            g.run(feed=_feed(), fetch_list=[loss])
+        g.close()
+    assert _counter_val("step_retries_total",
+                        site="checkpoint_write") == c0 + 1
+    assert ck.latest_step() >= 0   # the retried save completed
+
+
+def test_guardian_closed_refuses_runs_and_close_is_idempotent():
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main)
+        g.run(feed=_feed(), fetch_list=[loss])
+        g.close()
+        g.close()   # idempotent
+        with pytest.raises(RuntimeError):
+            g.run(feed=_feed(), fetch_list=[loss])
+
+
+def test_guardian_ctor_validation():
+    exe = fluid.Executor()
+    with pytest.raises(ValueError):
+        StepGuardian(exe, nonfinite_policy="ignore")
+    with pytest.raises(ValueError):
+        StepGuardian(exe, snapshot_interval=0)
+    with pytest.raises(ValueError):
+        StepGuardian(exe, max_retries=-1)
+
+
+def test_health_take_verdict_returns_and_clears():
+    from paddle_tpu.observability import health
+    # drain verdicts other tests' health checks may have left unconsumed
+    while health.take_verdict() is not None:
+        pass
+    with pytest.warns(UserWarning):
+        health.check([("a", np.array([np.nan], "float32"))], "prog:v0",
+                     health_mode="warn")
+    # a different program's read neither returns NOR clears the verdict
+    # (concurrent guardians must not steal each other's findings)
+    assert health.take_verdict("other:v0") is None
+    v = health.take_verdict("prog:v0")
+    assert v == {"program": "prog:v0", "where": "executor", "vars": ["a"]}
+    assert health.take_verdict("prog:v0") is None   # consumed
+
+
+def test_signal_handlers_refcounted_across_guardians(tmp_path):
+    """Closing one guardian must not strip SIGTERM routing from a sibling
+    that also holds the handlers."""
+    from paddle_tpu.utils.checkpointer import Checkpointer
+    main, startup, loss = _train_program()
+    orig_term = signal.getsignal(signal.SIGTERM)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck"))
+        g1 = StepGuardian(exe, main, checkpointer=ck)
+        g2 = StepGuardian(exe, main, checkpointer=ck)
+        assert signal.getsignal(signal.SIGTERM) is not orig_term
+        g1.close()
+        # g2 still holds a share: routing must survive
+        assert signal.getsignal(signal.SIGTERM) is not orig_term
+        signal.raise_signal(signal.SIGTERM)
+        assert recovery.preemption_requested()
+        recovery.clear_preemption()
+        g2.close()
+    assert signal.getsignal(signal.SIGTERM) is orig_term
+
+
+# ------------------------------------------------------------------ guards --
+
+@pytest.mark.smoke
+def test_zero_overhead_when_disabled(tmp_path, monkeypatch):
+    """Tier-1 guard (ISSUE 6 acceptance): with every resilience env var
+    unset and a default-configured guardian, guarded steps perform no file
+    I/O, install no signal handlers, spawn no threads, and take no
+    snapshots."""
+    for var in ("PADDLE_TPU_FAULTS", "PADDLE_TPU_OBS",
+                "PADDLE_TPU_OBS_HEALTH"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.chdir(tmp_path)
+    orig_term = signal.getsignal(signal.SIGTERM)
+    orig_int = signal.getsignal(signal.SIGINT)
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main)
+        g.run(feed=_feed(), fetch_list=[loss])   # compile outside the spy
+        threads_before = set(threading.enumerate())
+        opened = []
+        real_open = builtins.open
+
+        def spy_open(file, *a, **k):
+            opened.append(str(file))
+            return real_open(file, *a, **k)
+
+        monkeypatch.setattr(builtins, "open", spy_open)
+        try:
+            for _ in range(3):
+                g.run(feed=_feed(), fetch_list=[loss])
+        finally:
+            monkeypatch.setattr(builtins, "open", real_open)
+    watched = [p for p in opened if "journal" in p or ".jsonl" in p
+               or "ckpt" in p or "paddle_tpu" in p]
+    assert watched == [], f"guarded hot path opened files: {watched}"
+    assert list(tmp_path.iterdir()) == []
+    assert signal.getsignal(signal.SIGTERM) is orig_term
+    assert signal.getsignal(signal.SIGINT) is orig_int
+    assert not any(t.name == "resilience-step"
+                   for t in set(threading.enumerate()) - threads_before)
+    assert len(g._ring) == 0, "default guardian must not snapshot"
+
+
+def test_guardian_clean_run_byte_identical():
+    """ISSUE 6 acceptance: the same workload with PADDLE_TPU_FAULTS unset
+    runs byte-identically under the guardian and the bare executor."""
+    main, startup, loss = _train_program(dim=6, seed=3)
+    feeds = [np.random.RandomState(i).rand(2, 6).astype("float32")
+             for i in range(4)]
+
+    def run_seq(guarded):
+        main._rng_run_counter = 0
+        startup._rng_run_counter = 0
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            if guarded:
+                g = StepGuardian(exe, main)
+                step = lambda f: g.run(feed={"x": f},  # noqa: E731
+                                       fetch_list=[loss])
+            else:
+                step = lambda f: exe.run(main, feed={"x": f},  # noqa: E731
+                                         fetch_list=[loss])
+            out = [np.asarray(step(f)[0]) for f in feeds]
+        return np.stack(out)
+
+    plain, guarded = run_seq(False), run_seq(True)
+    assert plain.tobytes() == guarded.tobytes()
+
+
+# --------------------------------------------------------------- chaos CLI --
+
+def test_chaos_cli_selftest():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "tools",
+                                                     "chaos.py"),
+                        "--selftest"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "chaos selftest: OK" in r.stdout
+
+
+def test_chaos_cli_json_run(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.resilience", "--steps", "3",
+         "--faults", "exc@dispatch:step=1", "--policy", "skip",
+         "--format", "json", "--seed", "5"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-3000:]
+    summary = json.loads(r.stdout)
+    assert summary["steps_completed"] == 3
+    assert summary["events"]["retry"] >= 1
+    assert summary["events"]["fault"] >= 1
+    assert summary["final_loss"] is not None

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Launcher for the chaos CLI (``python -m paddle_tpu.resilience``).
+
+    python tools/chaos.py --steps 10 --policy skip --ckpt /tmp/ck \
+        --faults "nan:step=3:var=LOSS;exc@dispatch:step=5;preempt:step=7"
+    python tools/chaos.py --selftest
+
+Injects deterministic faults (NaN tensors, transient dispatch errors,
+hangs, simulated preemptions) into a small training run and reports what
+the resilience layer did about them: retries with backoff, skipped/rolled-
+back nonfinite steps, and the emergency checkpoint + resume after a
+preemption.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.resilience.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

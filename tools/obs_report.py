@@ -118,6 +118,66 @@ def render_health(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------- resilience --
+
+_RESILIENCE_EVENTS = ("fault", "retry", "skip", "rollback", "preempt",
+                      "step_timeout", "elastic_restart")
+
+
+def render_resilience(events: List[dict]) -> str:
+    """Recovery-layer activity in the journal: injected faults, step
+    retries, skipped/rolled-back nonfinite steps, preemption saves and
+    elastic restarts (paddle_tpu/resilience/)."""
+    lines = ["== Resilience =="]
+    by = {k: [e for e in events if e.get("event") == k]
+          for k in _RESILIENCE_EVENTS}
+    if not any(by.values()):
+        lines.append("quiet: no fault/retry/skip/rollback/preempt events")
+        return "\n".join(lines)
+    if by["fault"]:
+        counts = {}
+        for e in by["fault"]:
+            k = f"{e.get('kind', '?')}@{e.get('site', '?')}"
+            counts[k] = counts.get(k, 0) + 1
+        lines.append(f"{len(by['fault'])} injected fault(s): " + ", ".join(
+            f"{k} x{n}" for k, n in sorted(counts.items())))
+    if by["retry"]:
+        sites = {}
+        for e in by["retry"]:
+            sites[e.get("site", "?")] = sites.get(e.get("site", "?"), 0) + 1
+        lines.append(f"{len(by['retry'])} step retr(ies): " + ", ".join(
+            f"{s} x{n}" for s, n in sorted(sites.items())))
+        for e in by["retry"][-10:]:
+            lines.append(f"  retry step {e.get('step')} @{e.get('site')} "
+                         f"attempt {e.get('attempt')} "
+                         f"(backoff {e.get('backoff_ms')}ms): "
+                         f"{str(e.get('error', ''))[:80]}")
+    if by["skip"]:
+        steps = [e.get("step") for e in by["skip"]]
+        lines.append(f"{len(steps)} skipped nonfinite step(s): "
+                     f"{steps[-10:]}")
+    if by["rollback"]:
+        for e in by["rollback"][-10:]:
+            lines.append(f"ROLLBACK at step {e.get('step')} -> step "
+                         f"{e.get('to_step')} (source {e.get('source')}; "
+                         f"vars {e.get('vars')})")
+    if by["step_timeout"]:
+        lines.append(f"{len(by['step_timeout'])} hung step(s) deadlined: "
+                     f"steps {[e.get('step') for e in by['step_timeout']][-10:]}")
+    for e in by["preempt"]:
+        lines.append(f"PREEMPT at step {e.get('step')}: emergency "
+                     f"checkpoint step {e.get('saved_step')} "
+                     f"({e.get('reason')})")
+    if by["elastic_restart"]:
+        lines.append(f"{len(by['elastic_restart'])} elastic restart(s):")
+        for e in by["elastic_restart"][-10:]:
+            lines.append(f"  attempt {e.get('attempt')}/"
+                         f"{e.get('max_restarts')}: rank "
+                         f"{e.get('failed_rank')} failed, backoff "
+                         f"{e.get('backoff_s')}s")
+    return "\n".join(lines)
+
+
 # ----------------------------------------------------------------- memory --
 
 _MEMORY_FAMILIES = ("device_memory_bytes_in_use", "device_memory_peak_bytes",
@@ -262,6 +322,7 @@ def render_report(events: Optional[List[dict]],
     if events is not None:
         parts.append(render_journal(events))
         parts.append(render_health(events))
+        parts.append(render_resilience(events))
     if trace_events is not None:
         parts.append(render_timeline(trace_events))
     if snapshot is not None:
@@ -301,6 +362,11 @@ def selftest() -> int:
     reg.gauge("program_temp_bytes", program="1:v0").set(3e8)
     reg.counter("tensor_nonfinite_total", where="executor").inc()
     reg.counter("anomaly_total", kind="step_time").inc()
+    reg.counter("fault_injected_total", kind="nan", site="fetch").inc()
+    reg.counter("step_retries_total", site="dispatch").inc()
+    reg.counter("steps_skipped_total").inc()
+    reg.counter("rollback_total").inc()
+    reg.counter("preemption_saves_total").inc()
 
     events = [
         {"event": "run", "program": 1, "version": 0, "cache": "miss",
@@ -316,6 +382,21 @@ def selftest() -> int:
         {"event": "step_time_anomaly", "program": "1:v0", "step_ms": 99.0,
          "median_ms": 4.0, "mad_ms": 0.2, "limit_ms": 5.6, "n_window": 32,
          "ts": 4.0},
+        # resilience section (paddle_tpu/resilience/)
+        {"event": "fault", "kind": "nan", "site": "fetch", "step": 3,
+         "var": "loss", "program": "1:v0", "ts": 5.0},
+        {"event": "skip", "step": 3, "vars": ["loss"], "restored_step": 3,
+         "source": "ring", "ts": 5.5},
+        {"event": "retry", "site": "dispatch", "step": 5, "attempt": 1,
+         "backoff_ms": 42.0, "error": "UNAVAILABLE: injected transient",
+         "ts": 6.0},
+        {"event": "rollback", "step": 9, "to_step": 8, "source": "ring",
+         "vars": ["loss"], "ts": 7.0},
+        {"event": "preempt", "step": 7, "saved_step": 6,
+         "reason": "signal 15", "ts": 8.0},
+        {"event": "elastic_restart", "attempt": 1, "max_restarts": 2,
+         "failed_rank": 1, "exit_codes": [None, 3], "backoff_s": 1.4,
+         "ts": 9.0},
     ]
 
     # a synthetic flight-recorder trace through the real exporter
@@ -363,6 +444,14 @@ def selftest() -> int:
                      # health section
                      "NONFINITE executor", "'loss'", "step-time anomalies",
                      "99.0ms",
+                     # resilience section
+                     "1 injected fault(s): nan@fetch x1",
+                     "retry step 5 @dispatch attempt 1",
+                     "1 skipped nonfinite step(s): [3]",
+                     "ROLLBACK at step 9 -> step 8",
+                     "PREEMPT at step 7: emergency checkpoint step 6",
+                     "1 elastic restart(s)", "rank 1 failed",
+                     "fault_injected_total", "steps_skipped_total",
                      # memory section
                      "cpu:0", "512.000 MB", "peak 1.500 GB",
                      # timeline section
@@ -374,6 +463,7 @@ def selftest() -> int:
         assert "executor_cache_hits_total" in prom_report
         # empty journal/trace render degrades, never raises
         assert "healthy" in render_health([])
+        assert "quiet" in render_resilience([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})
     print("obs_report selftest: OK")

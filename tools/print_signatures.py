@@ -33,6 +33,7 @@ MODULES = [
     "paddle_tpu.contrib.quantize",
     "paddle_tpu.analysis",
     "paddle_tpu.tuning",
+    "paddle_tpu.resilience",
 ]
 
 
