@@ -27,7 +27,10 @@ Passes (pass_base registry, the ir::Pass analog): ``wellformed``
 (undefined/use-before-def vars, unregistered ops, block-graph sanity),
 ``dataflow`` (dead ops, WAW hazards, fetch reachability), ``typecheck``
 (shape/dtype propagation vs declarations), ``recompile`` (compile-cache
-churn risks).
+churn risks), ``distributed`` (collective/mesh consistency, SPMD deadlock
+shapes, sharding legality vs a DistributedStrategy), and the opt-in
+``memplan`` (static liveness-based peak-memory planner, engaged by
+``mem_budget=`` / ``--mem-budget`` or by naming the pass).
 """
 from __future__ import annotations
 
@@ -35,12 +38,18 @@ from typing import List, Optional, Sequence
 
 from ..framework import Program
 from . import dataflow  # noqa: F401  (registers the pass)
+from . import distributed  # noqa: F401
+from . import memplan  # noqa: F401
 from . import recompile  # noqa: F401
 from . import typecheck  # noqa: F401
 from . import wellformed  # noqa: F401
 from .diagnostics import (CODES, Diagnostic, Severity,  # noqa: F401
-                          codes_table, count_by_severity,
-                          format_diagnostics, sort_diagnostics)
+                          apply_baseline, codes_table, count_by_severity,
+                          format_diagnostics, load_baseline,
+                          sort_diagnostics, write_baseline)
+from .distributed import strategy_from_dict  # noqa: F401
+from .memplan import (MemEstimate, estimate_program_memory,  # noqa: F401
+                      format_bytes, infer_batch, parse_bytes)
 from .pass_base import (AnalysisPass, PassContext,  # noqa: F401
                         default_passes, get_pass, register_pass,
                         registered_passes, run_passes)
@@ -58,7 +67,9 @@ class VerificationError(RuntimeError):
 def verify(program: Program,
            feed_names: Optional[Sequence[str]] = None,
            fetch_names: Optional[Sequence[str]] = None,
-           passes: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+           passes: Optional[Sequence[str]] = None,
+           strategy=None, mem_budget: Optional[int] = None,
+           batch: Optional[int] = None) -> List[Diagnostic]:
     """Run the analysis pipeline over ``program``; return sorted findings.
 
     ``feed_names``/``fetch_names`` sharpen the analysis when the run intent
@@ -66,20 +77,44 @@ def verify(program: Program,
     liveness and fetch-reachability, feeds tighten the unread-feed check.
     Without them the checks degrade gracefully (is_data vars are assumed
     feedable, liveness is skipped).
+
+    ``strategy`` (a DistributedStrategy or a CompiledProgram) switches on
+    the PT04x distributed checks -- collective/mesh consistency, sharding
+    legality, re-gather cost -- and scales the memory planner's byte
+    accounting by the sharding divisors. ``mem_budget`` (bytes) adds the
+    PT05x static peak-memory planner to the pipeline and errors (PT051)
+    when the estimate exceeds it; ``batch`` resolves dynamic (-1) dims for
+    that accounting (without it the planner assumes batch 1 and says so,
+    PT052).
     """
+    # supplying a budget or a strategy means the caller wants that check's
+    # verdict: engage the owning pass even under an explicit --passes
+    # subset (a CI gate narrowing passes must not silently lose the PT051
+    # OOM check or the PT04x deadlock/sharding checks it asked for)
+    if mem_budget is not None:
+        passes = list(passes) if passes is not None else default_passes()
+        if "memplan" not in passes:
+            passes = passes + ["memplan"]
+    if strategy is not None and passes is not None \
+            and "distributed" not in passes:
+        passes = list(passes) + ["distributed"]
     return sort_diagnostics(run_passes(program, passes=passes,
                                        feed_names=feed_names,
-                                       fetch_names=fetch_names))
+                                       fetch_names=fetch_names,
+                                       strategy=strategy,
+                                       mem_budget=mem_budget, batch=batch))
 
 
 def verify_or_raise(program: Program,
                     feed_names: Optional[Sequence[str]] = None,
                     fetch_names: Optional[Sequence[str]] = None,
-                    passes: Optional[Sequence[str]] = None
-                    ) -> List[Diagnostic]:
+                    passes: Optional[Sequence[str]] = None,
+                    strategy=None, mem_budget: Optional[int] = None,
+                    batch: Optional[int] = None) -> List[Diagnostic]:
     """verify(), raising VerificationError if any error-severity finding."""
     diags = verify(program, feed_names=feed_names, fetch_names=fetch_names,
-                   passes=passes)
+                   passes=passes, strategy=strategy, mem_budget=mem_budget,
+                   batch=batch)
     errors = [d for d in diags if d.severity == Severity.ERROR]
     if errors:
         raise VerificationError(

@@ -1,6 +1,10 @@
 """CLI: lint a serialized Program (``Program.to_json`` output).
 
     python -m paddle_tpu.analysis prog.json [--fetch loss] [--feed img]
+    python -m paddle_tpu.analysis prog.json --strategy strat.json \
+        --mem-budget 8G --batch 256          # distributed + memory checks
+    python -m paddle_tpu.analysis prog.json --baseline accepted.keys \
+        [--update-baseline]                  # CI: gate on NEW findings only
     python -m paddle_tpu.analysis --codes        # diagnostic-code table
     python -m paddle_tpu.analysis --selftest     # pinned by the test suite
 
@@ -16,8 +20,20 @@ import sys
 from typing import List, Optional
 
 from ..framework import Program
-from . import (CODES, Severity, codes_table, count_by_severity,
-               format_diagnostics, registered_passes, verify)
+from . import (CODES, Severity, apply_baseline, codes_table,
+               count_by_severity, format_diagnostics, load_baseline,
+               registered_passes, strategy_from_dict, verify,
+               write_baseline)
+
+
+def parse_bytes(s: str) -> int:
+    """argparse type wrapper over memplan.parse_bytes."""
+    from .memplan import parse_bytes as _pb
+    try:
+        return _pb(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"not a byte count: {s!r} (use an int or a K/M/G/T suffix)")
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -37,6 +53,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--passes", default=None,
                     help="comma-separated pass subset "
                          f"(default: all of {registered_passes()})")
+    ap.add_argument("--strategy", default=None, metavar="FILE",
+                    help="DistributedStrategy JSON (mesh_shape/param_rules/"
+                         "data_rules/data_axis, optional reduce_strategy/"
+                         "reduce_params): enables the PT04x distributed "
+                         "checks and sharding-aware memory accounting")
+    ap.add_argument("--mem-budget", default=None, type=parse_bytes,
+                    metavar="BYTES",
+                    help="per-device memory budget (int or K/M/G/T suffix); "
+                         "runs the static peak-memory planner (PT05x) and "
+                         "errors when the estimate exceeds it")
+    ap.add_argument("--batch", default=None, type=int,
+                    help="batch size resolving dynamic (-1) dims for the "
+                         "memory planner and divisibility checks")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="suppression file of accepted Diagnostic keys: "
+                         "findings matching an entry are dropped before "
+                         "output/exit-code, so CI gates on NEW findings "
+                         "only (write one with --update-baseline)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings' keys to --baseline "
+                         "(byte-stable ordering) and exit 0")
     ap.add_argument("--fail-on", choices=("error", "warn", "never"),
                     default="error",
                     help="exit 1 when findings at/above this severity "
@@ -151,6 +188,65 @@ def _selftest() -> int:
                         f"{[d.key() for d in d1]}\nvs\n"
                         f"{[d.key() for d in d2]}")
 
+    # collective over an axis the mesh lacks (needs a strategy) + a
+    # collective that is NOT dead despite feeding no fetch
+    strat = strategy_from_dict({"mesh_shape": {"dp": 8}})
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (8, 4), "float32", is_data=True)
+    b.append_op("c_allreduce_sum", inputs={"X": ["x"]},
+                outputs={"Out": ["red"]}, attrs={"axis_name": "mp"},
+                infer_shape=False)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    expect("collective axis", verify(p, fetch_names=["y"], strategy=strat),
+           has=("PT040",), lacks=("PT010",))
+
+    # collective inside a cond branch: the SPMD deadlock shape
+    p = Program()
+    gb = p.global_block()
+    gb.create_var("x", (8, 4), "float32", is_data=True)
+    gb.create_var("c", (1,), "bool", is_data=True)
+    sub = p._create_block()
+    sub.append_op("c_allreduce_sum", inputs={"X": ["x"]},
+                  outputs={"Out": ["r"]}, infer_shape=False)
+    p._rollback()
+    gb.append_op("conditional_block", inputs={"Cond": ["c"], "X": ["x"]},
+                 outputs={"Out": ["o"]},
+                 attrs={"sub_block": sub.idx, "x_names": ["x"],
+                        "out_names": ["r"]}, infer_shape=False)
+    expect("divergent collective", verify(p), has=("PT041",))
+
+    # memory planner: tiny budget trips PT051, assumed batch trips PT052
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (-1, 1024), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    diags = verify(p, fetch_names=["y"], mem_budget=16)
+    expect("mem budget", diags, has=("PT050", "PT051", "PT052"))
+    expect("mem budget ok", verify(p, fetch_names=["y"], batch=4,
+                                   mem_budget=1 << 30),
+           has=("PT050",), lacks=("PT051", "PT052"))
+
+    # baseline round trip: accepted findings suppress byte-stably
+    import tempfile
+    p = Program()
+    b = p.global_block()
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]},
+                infer_shape=False)
+    diags = verify(p)
+    with tempfile.NamedTemporaryFile("w", suffix=".keys",
+                                     delete=False) as f:
+        base_path = f.name
+    try:
+        write_baseline(base_path, diags)
+        kept, supp = apply_baseline(verify(p), load_baseline(base_path))
+        if kept or len(supp) != len(diags):
+            failures.append(f"baseline: kept {len(kept)}, suppressed "
+                            f"{len(supp)} of {len(diags)}")
+    finally:
+        import os
+        os.unlink(base_path)
+
     if failures:
         print("selftest: FAILED")
         for f in failures:
@@ -172,18 +268,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         build_arg_parser().print_usage()
         print("error: need a program JSON path (or --codes/--selftest)")
         return 2
+    if args.update_baseline and not args.baseline:
+        print("error: --update-baseline needs --baseline FILE")
+        return 2
     try:
         program = _load_program(args.program)
     except (OSError, ValueError, KeyError) as e:
         print(f"error: cannot load program from {args.program!r}: {e}")
         return 2
+    strategy = None
+    if args.strategy:
+        try:
+            with open(args.strategy) as f:
+                strategy = strategy_from_dict(json.load(f))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: cannot load strategy from {args.strategy!r}: {e}")
+            return 2
     passes = args.passes.split(",") if args.passes else None
     try:
         diags = verify(program, feed_names=args.feed,
-                       fetch_names=args.fetch, passes=passes)
+                       fetch_names=args.fetch, passes=passes,
+                       strategy=strategy, mem_budget=args.mem_budget,
+                       batch=args.batch)
     except KeyError as e:
         print(f"error: {e}")
         return 2
+    if args.update_baseline:
+        n = write_baseline(args.baseline, diags)
+        print(f"baseline: wrote {n} entr(ies) to {args.baseline}")
+        return 0
+    if args.baseline:
+        try:
+            keys = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load baseline from {args.baseline!r}: {e}")
+            return 2
+        diags, suppressed = apply_baseline(diags, keys)
+        if suppressed and args.format == "text":
+            print(f"(baseline: {len(suppressed)} finding(s) suppressed by "
+                  f"{args.baseline})")
     _emit(diags, args)
     return _exit_code(diags, args.fail_on)
 

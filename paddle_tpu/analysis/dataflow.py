@@ -17,15 +17,24 @@ from __future__ import annotations
 
 from typing import Dict, List, Set
 
+from ..ops.collective import is_collective
 from .diagnostics import Diagnostic
 from .pass_base import (AnalysisPass, PassContext, op_input_names,
                         op_output_names, register_pass, sub_block_indices)
 
 #: op types that must never be pruned/reported dead: they act on the world
-#: (stdout, the host-side embedding tables) rather than on the dataflow
+#: (stdout, the host-side embedding tables) rather than on the dataflow.
+#: Collective/communication ops (ops.collective.COLLECTIVE_OPS) are
+#: side-effecting too -- every rank of the axis must execute the same
+#: collective sequence, so a psum whose output feeds only a stage boundary
+#: is NOT dead: pruning it on one rank desynchronizes the others.
 SIDE_EFFECT_OPS = frozenset({
     "print", "assert", "host_table_push", "host_table_init",
 })
+
+
+def _is_side_effecting(op_type: str) -> bool:
+    return op_type in SIDE_EFFECT_OPS or is_collective(op_type)
 
 
 def op_reads(program, op) -> List[str]:
@@ -111,7 +120,7 @@ class DataflowPass(AnalysisPass):
             outs = op_output_names(op)
             if (any(n in needed for n in outs)
                     or any(n in persistable for n in outs)
-                    or op.type in SIDE_EFFECT_OPS):
+                    or _is_side_effecting(op.type)):
                 live.add(i)
                 needed.update(reads_at[i])
         return live

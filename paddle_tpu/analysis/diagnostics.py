@@ -77,6 +77,34 @@ CODES: Dict[str, tuple] = {
     "PT033": (Severity.INFO, "program has stochastic ops but no "
                              "random_seed: seed 0 is baked into the "
                              "compiled step"),
+    # -- distributed consistency (distributed.py) --------------------------
+    "PT040": (Severity.ERROR, "collective op communicates over a mesh axis "
+                              "the strategy's mesh does not define"),
+    "PT041": (Severity.ERROR, "collective op inside divergent control flow "
+                              "(cond branch / data-dependent while): ranks "
+                              "can disagree and deadlock"),
+    "PT042": (Severity.ERROR, "pipeline stages disagree on their collective "
+                              "op sequence: stage programs run in lockstep "
+                              "and would desynchronize"),
+    "PT043": (Severity.ERROR, "sharding rule names a mesh axis that is not "
+                              "in the strategy's mesh_shape"),
+    "PT044": (Severity.ERROR, "sharding spec has more entries than the "
+                              "variable has dims (spec on a missing dim)"),
+    "PT045": (Severity.ERROR, "sharded dim size is not divisible by the "
+                              "product of its mesh axis sizes"),
+    "PT046": (Severity.WARN, "strategy forces a per-step re-gather: "
+                             "ZeRO-sharded params are all-gathered at every "
+                             "use (or stay replicated, losing the memory "
+                             "win)"),
+    # -- static memory planning (memplan.py) -------------------------------
+    "PT050": (Severity.INFO, "static peak-memory estimate for the program "
+                             "(liveness over the IR, sharding divisors and "
+                             "donation applied)"),
+    "PT051": (Severity.ERROR, "static peak-memory estimate exceeds the "
+                              "memory budget"),
+    "PT052": (Severity.WARN, "memory estimate resolved dynamic (-1) dims "
+                             "with an assumed batch size; pass the real "
+                             "batch for a trustworthy number"),
 }
 
 
@@ -199,3 +227,59 @@ def codes_table() -> str:
     for code, (sev, summary) in sorted(CODES.items()):
         lines.append(f"{code}  {sev:<8}  {summary}")
     return "\n".join(lines)
+
+
+# ------------------------------------------------------------- baselines --
+# A baseline is a suppression file of Diagnostic.key()s: CI lints with
+# --baseline FILE and gates on *new* findings only, so a legacy program's
+# accepted findings don't block unrelated changes. Keys (not raw messages)
+# make the file robust to creation-stack differences, and the byte-stable
+# ordering (sort_diagnostics, then the key tuple itself) means regenerating
+# an unchanged baseline is a no-op diff.
+
+def write_baseline(path: str, diags: List[Diagnostic]) -> int:
+    """Write the suppression file for ``diags``; returns the entry count.
+    Duplicate keys (one finding per program point) collapse to one line."""
+    import json
+    seen = []
+    for d in sort_diagnostics(diags):
+        k = list(d.key())
+        if k not in seen:
+            seen.append(k)
+    with open(path, "w") as f:
+        f.write("# paddle_tpu analysis baseline: one Diagnostic.key() per "
+                "line; findings matching a key are suppressed\n")
+        for k in seen:
+            f.write(json.dumps(k) + "\n")
+    return len(seen)
+
+
+def load_baseline(path: str) -> set:
+    """Read a suppression file -> set of key tuples. Raises OSError on a
+    missing file and ValueError on a malformed line (a typo in the baseline
+    must not silently un-suppress everything)."""
+    import json
+    keys = set()
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                k = json.loads(line)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{ln}: malformed baseline entry: {e}") from None
+            if not isinstance(k, list):
+                raise ValueError(f"{path}:{ln}: baseline entry must be a "
+                                 f"JSON list (got {type(k).__name__})")
+            keys.add(tuple(k))
+    return keys
+
+
+def apply_baseline(diags: List[Diagnostic], keys: set):
+    """Split ``diags`` into (kept, suppressed) against a baseline key set."""
+    kept, suppressed = [], []
+    for d in diags:
+        (suppressed if d.key() in keys else kept).append(d)
+    return kept, suppressed

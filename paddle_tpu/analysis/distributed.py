@@ -1,0 +1,368 @@
+"""Distributed-consistency pass: collective structure + sharding legality.
+
+SPMD training makes communication structure a correctness surface: every
+rank of a mesh axis must execute the SAME sequence of collectives, in the
+same order, or the program deadlocks all ranks at the first mismatched
+synchronization point -- on device, minutes into a run, with no stack. The
+checks here decide the failure statically, from the `(Program,
+DistributedStrategy)` pair:
+
+- PT040: a collective op's axis name is not an axis of the strategy's mesh.
+  Outside a bound axis the lowering degrades to identity (ops/collective.py
+  ``_axis_bound``) -- the reduction silently never happens.
+- PT041: a collective inside *divergent* control flow: a ``cond`` branch, or
+  a ``while`` without ``max_iters`` (data-dependent trip count). Ranks can
+  disagree on the branch/trip count, so a rank can sit in a collective its
+  peers never enter -- the classic SPMD deadlock. ``while`` WITH
+  ``max_iters`` is uniform (it lowers to a masked scan of fixed length:
+  every rank runs every iteration), as are ``scan``/``remat_segment``.
+- PT042: device_guard("stage:i")-annotated pipeline stages whose collective
+  sequences differ. Stage programs execute in lockstep under the GPipe
+  schedule; a collective present in one stage and absent in another
+  desynchronizes the pipe.
+- PT043/PT044/PT045: sharding-spec legality against declared var shapes:
+  a rule naming a mesh axis that does not exist, a spec with more entries
+  than the var has dims (the compiler silently replicates -- the user's
+  sharding silently never happens), and a sharded dim not divisible by the
+  product of its axis sizes.
+- PT046 (warn): strategy combinations that force a per-step re-gather:
+  ``ReduceStrategy.Reduce`` + ``reduce_params`` all-gathers every sharded
+  parameter at each use (ZeRO-3's bandwidth bill, estimated in bytes), and
+  Reduce-mode state that cannot shard (no dim divides dp) silently stays
+  replicated, losing the memory win.
+
+The axis/comm metadata comes from ``ops.collective.COLLECTIVE_OPS`` --
+op-level tags, so new collective ops opt into all of these checks by adding
+one table entry.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..ops.collective import COLLECTIVE_OPS, collective_axis, is_collective
+from .diagnostics import Diagnostic
+from .pass_base import (AnalysisPass, PassContext, register_pass,
+                        sub_block_indices)
+
+
+def dtype_bytes(dtype: str) -> int:
+    import numpy as np
+    if dtype == "bfloat16":
+        return 2
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 4
+
+
+def spec_entries(spec) -> List[Tuple[str, ...]]:
+    """PartitionSpec -> per-dim tuples of axis names (() = replicated dim)."""
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(())
+        elif isinstance(e, (list, tuple)):
+            out.append(tuple(e))
+        else:
+            out.append((e,))
+    return out
+
+
+def axis_product(entry: Tuple[str, ...], sizes: Dict[str, int]) -> int:
+    n = 1
+    for a in entry:
+        n *= int(sizes.get(a, 1))
+    return n
+
+
+class _StrategyBundle:
+    """dist+build strategy pair without a Program (the CLI's --strategy
+    door; pass_base.split_strategy unpacks it like a CompiledProgram)."""
+
+    def __init__(self, dist_strategy, build_strategy):
+        self.dist_strategy = dist_strategy
+        self.build_strategy = build_strategy
+
+
+def strategy_from_dict(d: dict):
+    """Deserialize an analysis strategy spec (the ``--strategy file.json``
+    format): DistributedStrategy fields plus the two BuildStrategy knobs the
+    checks consume (``reduce_strategy``: "AllReduce"|"Reduce"|0|1,
+    ``reduce_params``: bool). Returns a DistributedStrategy, or a bundle
+    carrying both halves when a build knob is present."""
+    from ..compiler import BuildStrategy, DistributedStrategy
+    ds = DistributedStrategy.from_dict(d)
+    if "reduce_strategy" not in d and "reduce_params" not in d:
+        return ds
+    bs = BuildStrategy()
+    rs = d.get("reduce_strategy", "AllReduce")
+    if rs in ("Reduce", BuildStrategy.ReduceStrategy.Reduce):
+        bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
+    elif rs not in ("AllReduce", BuildStrategy.ReduceStrategy.AllReduce):
+        raise ValueError(f"reduce_strategy must be AllReduce|Reduce, "
+                         f"got {rs!r}")
+    bs.reduce_params = bool(d.get("reduce_params", False))
+    return _StrategyBundle(ds, bs)
+
+
+def _mesh_axes(ds) -> Set[str]:
+    """Axis names the strategy's mesh will have. An empty mesh_shape means
+    build_mesh defaults to {data_axis: all devices}."""
+    return set(ds.mesh_shape) if ds.mesh_shape else {ds.data_axis}
+
+
+def _stage_of(op) -> Optional[int]:
+    d = op.attr("op_device")
+    if isinstance(d, str) and d.startswith("stage:"):
+        try:
+            return int(d.split(":", 1)[1])
+        except ValueError:
+            return None
+    return None
+
+
+@register_pass
+class DistributedPass(AnalysisPass):
+    name = "distributed"
+
+    def run(self, ctx: PassContext) -> List[Diagnostic]:
+        diags: List[Diagnostic] = []
+        has_coll = any(is_collective(op.type)
+                       for b in ctx.program.blocks for op in b.ops)
+        if has_coll:
+            self._check_divergence(ctx, diags)
+            self._check_stage_sequences(ctx, diags)
+        if ctx.strategy is not None:
+            if has_coll:
+                self._check_axes(ctx, diags)
+            self._check_sharding(ctx, diags)
+            self._check_regather(ctx, diags)
+        return diags
+
+    # ------------------------------------------------------------ PT041 --
+    @staticmethod
+    def _divergent_children(op) -> Tuple[List[int], List[int]]:
+        """(divergent sub-block idxs, uniform sub-block idxs) of ``op``.
+        Divergent = ranks can disagree on whether/how often the block body
+        runs: cond branches, and while with a data-dependent trip count."""
+        subs = []
+        for k in sorted(op.attrs):
+            if k.endswith("_block"):
+                v = op.attrs[k]
+                if k == "else_block" and v == -1:
+                    continue
+                if isinstance(v, int) and not isinstance(v, bool):
+                    subs.append(v)
+        if op.type == "conditional_block":
+            return subs, []
+        if op.type == "while" and op.attr("max_iters") is None:
+            return subs, []
+        return [], subs
+
+    def _check_divergence(self, ctx, diags):
+        prog = ctx.program
+        nblocks = len(prog.blocks)
+        seen: Set[Tuple[int, bool]] = set()
+
+        def walk(bidx: int, divergent: bool, stack: Set[int]):
+            if bidx in stack or not 0 <= bidx < nblocks:
+                return
+            if (bidx, divergent) in seen:
+                return
+            seen.add((bidx, divergent))
+            block = prog.blocks[bidx]
+            for op in block.ops:
+                if divergent and is_collective(op.type):
+                    meta = COLLECTIVE_OPS[op.type]
+                    diags.append(Diagnostic.for_op(
+                        "PT041", f"{meta['comm']} over axis "
+                                 f"{collective_axis(op)!r} executes inside "
+                                 f"control flow whose branch/trip count can "
+                                 f"differ across ranks; a rank entering the "
+                                 f"collective while a peer skips it "
+                                 f"deadlocks the whole axis (hoist it out, "
+                                 f"or bound the loop with max_iters)",
+                        block, op))
+                div_subs, uni_subs = self._divergent_children(op)
+                for si in div_subs:
+                    walk(si, True, stack | {bidx})
+                for si in uni_subs:
+                    walk(si, divergent, stack | {bidx})
+
+        walk(0, False, set())
+
+    # ------------------------------------------------------------ PT042 --
+    def _check_stage_sequences(self, ctx, diags):
+        prog = ctx.program
+        per_stage: Dict[int, List[Tuple]] = {}
+        first_op: Dict[int, Tuple] = {}
+        for b in prog.blocks:
+            for op in b.ops:
+                s = _stage_of(op)
+                if s is None:
+                    continue
+                first_op.setdefault(s, (b, op))
+                if is_collective(op.type):
+                    per_stage.setdefault(s, []).append(
+                        (op.type, collective_axis(op)))
+                    first_op.setdefault(("coll", s), (b, op))
+        stage_ids = sorted(s for s in first_op if isinstance(s, int))
+        if len(stage_ids) < 2:
+            return
+        ref_id = stage_ids[0]
+        ref = per_stage.get(ref_id, [])
+        for s in stage_ids[1:]:
+            got = per_stage.get(s, [])
+            if got == ref:
+                continue
+            b, op = first_op.get(("coll", s)) or first_op[s]
+            diags.append(Diagnostic.for_op(
+                "PT042", f"pipeline stage {s} runs collective sequence "
+                         f"{got!r} but stage {ref_id} runs {ref!r}; stages "
+                         f"execute in lockstep under the GPipe schedule and "
+                         f"mismatched collective counts desynchronize the "
+                         f"ranks", b, op))
+
+    # ------------------------------------------------------------ PT040 --
+    def _check_axes(self, ctx, diags):
+        axes = _mesh_axes(ctx.strategy)
+        for b in ctx.program.blocks:
+            for op in b.ops:
+                if not is_collective(op.type):
+                    continue
+                axis = collective_axis(op)
+                if axis in axes:
+                    continue
+                diags.append(Diagnostic.for_op(
+                    "PT040", f"collective communicates over axis {axis!r} "
+                             f"but the mesh defines only "
+                             f"{sorted(axes)}; outside a bound axis the op "
+                             f"lowers to identity and the "
+                             f"{COLLECTIVE_OPS[op.type]['comm']} silently "
+                             f"never happens", b, op, var=axis))
+
+    # --------------------------------------------------- PT043/044/045 --
+    def _check_sharding(self, ctx, diags):
+        from ..framework import Parameter
+        ds = ctx.strategy
+        sizes = dict(ds.mesh_shape)
+        axes = _mesh_axes(ds)
+        for b in ctx.program.blocks:
+            for n, v in b.vars.items():
+                if v.persistable:
+                    spec = spec_entries(ds.param_spec(n))
+                    kind = "param"
+                elif v.is_data:
+                    spec = spec_entries(ds.data_spec(n, v.ndim))
+                    kind = "data"
+                else:
+                    continue
+                used = [a for e in spec for a in e]
+                for a in used:
+                    if a not in axes:
+                        diags.append(Diagnostic(
+                            "PT043", f"sharding rule for {kind} var {n!r} "
+                                     f"names mesh axis {a!r}, but the mesh "
+                                     f"defines only {sorted(axes)}",
+                            block_idx=b.idx, var=n))
+                if len(spec) > v.ndim:
+                    extra = spec[v.ndim:]
+                    if kind == "data" or isinstance(v, Parameter):
+                        diags.append(Diagnostic(
+                            "PT044", f"{kind} var {n!r} has {v.ndim} dims "
+                                     f"but its sharding spec has "
+                                     f"{len(spec)} entries (extra: "
+                                     f"{extra!r}); the compiler falls back "
+                                     f"to full replication, so the "
+                                     f"requested sharding silently never "
+                                     f"happens", block_idx=b.idx, var=n))
+                    # persistable non-Parameters (derived accumulators like
+                    # Adam's beta-pow matched by a name-prefix rule) are the
+                    # compiler's documented replicate-on-rank-mismatch case
+                    continue
+                for dim, entry in enumerate(spec):
+                    nshards = axis_product(entry, sizes)
+                    if nshards <= 1:
+                        continue
+                    extent = v.shape[dim] if dim < v.ndim else None
+                    if extent == -1 and dim == 0 and ctx.batch is not None:
+                        extent = ctx.batch
+                    if not isinstance(extent, int) or extent <= 0:
+                        continue  # dynamic dim, unknown at lint time
+                    if extent % nshards:
+                        diags.append(Diagnostic(
+                            "PT045", f"{kind} var {n!r} dim {dim} "
+                                     f"(={extent}) is sharded over "
+                                     f"{entry!r} ({nshards} shards) but is "
+                                     f"not divisible; XLA would pad or the "
+                                     f"executor reject the feed -- pad the "
+                                     f"dim or change the mesh",
+                            block_idx=b.idx, var=n))
+
+    # ------------------------------------------------------------ PT046 --
+    def _check_regather(self, ctx, diags):
+        from ..compiler import BuildStrategy
+        from ..framework import Parameter
+        bs = ctx.build_strategy
+        if bs is None or \
+                bs.reduce_strategy != BuildStrategy.ReduceStrategy.Reduce:
+            return
+        ds = ctx.strategy
+        sizes = dict(ds.mesh_shape)
+        ndp = int(sizes.get("dp", 0)) if sizes else None  # None = default dp
+        if ndp is not None and ndp <= 1:
+            return  # no dp axis worth sharding over
+        gb = ctx.program.global_block()
+
+        def replicated(n):
+            return not any(spec_entries(ds.param_spec(n)))
+
+        if getattr(bs, "reduce_params", False):
+            gathered, total = [], 0
+            for n, v in gb.vars.items():
+                if not isinstance(v, Parameter) or not replicated(n):
+                    continue
+                dp = ndp or 2
+                if any(isinstance(s, int) and s > 0 and s % dp == 0
+                       for s in v.shape) or ndp is None:
+                    nbytes = dtype_bytes(v.dtype)
+                    for s in v.shape:
+                        nbytes *= max(1, s)
+                    gathered.append((nbytes, n))
+                    total += nbytes
+            if gathered:
+                gathered.sort(reverse=True)
+                top = ", ".join(f"{n} ({b} B)" for b, n in gathered[:3])
+                diags.append(Diagnostic(
+                    "PT046", f"ReduceStrategy.Reduce + reduce_params "
+                             f"shards {len(gathered)} parameter(s) over dp "
+                             f"and GSPMD all-gathers each at every use: "
+                             f"~{total} bytes re-gathered per device per "
+                             f"step (top: {top}); the memory win costs "
+                             f"this bandwidth every step", block_idx=0))
+        if ndp is None:
+            return
+        stuck, stuck_bytes = [], 0
+        for n, v in gb.vars.items():
+            if not v.persistable or not replicated(n):
+                continue
+            if isinstance(v, Parameter) and \
+                    not getattr(bs, "reduce_params", False):
+                continue  # params deliberately replicated in ZeRO-1 mode
+            shards = any(isinstance(s, int) and s > 0 and s % ndp == 0
+                         for s in v.shape)
+            big = any(isinstance(s, int) and s > ndp for s in v.shape)
+            if not shards and big:
+                nbytes = dtype_bytes(v.dtype)
+                for s in v.shape:
+                    nbytes *= max(1, s)
+                stuck.append(n)
+                stuck_bytes += nbytes
+        if stuck:
+            diags.append(Diagnostic(
+                "PT046", f"ReduceStrategy.Reduce cannot shard "
+                         f"{len(stuck)} state var(s) (no dim divides "
+                         f"dp={ndp}): {stuck[:3]} stay fully replicated "
+                         f"(~{stuck_bytes} bytes per device that ZeRO was "
+                         f"meant to save); pad the dims or change dp",
+                block_idx=0))

@@ -44,18 +44,39 @@ def sub_block_indices(op: Operator, program: Program) -> List[int]:
     return out
 
 
+def split_strategy(strategy):
+    """Normalize verify()'s ``strategy`` argument -- a DistributedStrategy
+    OR a CompiledProgram wrapper -- to (DistributedStrategy, BuildStrategy).
+    Either half may be None."""
+    if strategy is None:
+        return None, None
+    ds = getattr(strategy, "dist_strategy", None)
+    if ds is not None or hasattr(strategy, "build_strategy"):
+        # CompiledProgram: carries both halves
+        return ds, getattr(strategy, "build_strategy", None)
+    return strategy, None
+
+
 class PassContext:
     """Program + run intent + memoized program-wide facts."""
 
     def __init__(self, program: Program,
                  feed_names: Optional[Sequence[str]] = None,
-                 fetch_names: Optional[Sequence[str]] = None):
+                 fetch_names: Optional[Sequence[str]] = None,
+                 strategy=None, mem_budget: Optional[int] = None,
+                 batch: Optional[int] = None):
         self.program = program
         # empty == unknown intent, same as None: an executor run with no
         # fetch_list must not flag the whole program dead (PT010), and
         # every consumer below branches on None, not truthiness
         self.feed_names = list(feed_names) if feed_names else None
         self.fetch_names = list(fetch_names) if fetch_names else None
+        # distributed intent: a DistributedStrategy (or a CompiledProgram,
+        # normalized here) switches on the PT04x checks and scales the
+        # PT05x byte accounting by the sharding divisors
+        self.strategy, self.build_strategy = split_strategy(strategy)
+        self.mem_budget = mem_budget
+        self.batch = batch
         self._referencing: Optional[Dict[int, List[Tuple[int, int]]]] = None
         self._roots: Optional[Set[str]] = None
 
@@ -144,9 +165,11 @@ def default_passes() -> List[str]:
 
 def run_passes(program: Program, passes: Optional[Sequence[str]] = None,
                feed_names: Optional[Sequence[str]] = None,
-               fetch_names: Optional[Sequence[str]] = None
-               ) -> List[Diagnostic]:
-    ctx = PassContext(program, feed_names=feed_names, fetch_names=fetch_names)
+               fetch_names: Optional[Sequence[str]] = None,
+               strategy=None, mem_budget: Optional[int] = None,
+               batch: Optional[int] = None) -> List[Diagnostic]:
+    ctx = PassContext(program, feed_names=feed_names, fetch_names=fetch_names,
+                      strategy=strategy, mem_budget=mem_budget, batch=batch)
     diags: List[Diagnostic] = []
     for name in (passes if passes is not None else default_passes()):
         diags.extend(get_pass(name).run(ctx))

@@ -139,6 +139,29 @@ class DistributedStrategy:
                             "ICI has no rings to tune")
         object.__setattr__(self, name, value)
 
+    # -- serialization (analysis CLI --strategy files, tooling) ------------------------
+    def to_dict(self) -> dict:
+        return {"mesh_shape": dict(self.mesh_shape),
+                "param_rules": [[p, list(s)] for p, s in self.param_rules],
+                "data_rules": [[p, list(s)] for p, s in self.data_rules],
+                "data_axis": self.data_axis}
+
+    @staticmethod
+    def from_dict(d: dict) -> "DistributedStrategy":
+        """Build a strategy from the JSON shape ``to_dict`` emits. Spec
+        entries may be axis names, null (replicated dim), or lists of axis
+        names (a dim sharded over multiple axes)."""
+
+        def spec(entries):
+            return tuple(tuple(e) if isinstance(e, list) else e
+                         for e in entries)
+
+        return DistributedStrategy(
+            mesh_shape=dict(d.get("mesh_shape") or {}),
+            param_rules=[(p, spec(s)) for p, s in d.get("param_rules") or []],
+            data_rules=[(p, spec(s)) for p, s in d.get("data_rules") or []],
+            data_axis=d.get("data_axis", "dp"))
+
     # -- mesh --------------------------------------------------------------------------
     def build_mesh(self, devices=None):
         import jax

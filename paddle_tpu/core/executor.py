@@ -44,7 +44,8 @@ from .registry import EMPTY_VAR, LowerCtx, stable_salt
 _PROGRAM_GAUGES = ("program_flops", "program_bytes_accessed",
                    "program_arithmetic_intensity", "program_flops_per_sec",
                    "program_mfu", "program_peak_bytes", "program_temp_bytes",
-                   "program_argument_bytes", "program_output_bytes")
+                   "program_argument_bytes", "program_output_bytes",
+                   "program_static_peak_bytes", "program_static_peak_ratio")
 
 
 def _retire_program_gauges_if_dead(prog_id, version):
@@ -264,21 +265,57 @@ class Executor:
         # policy on retries of a failing program.
         self._verified: Dict[Tuple, Tuple[Program, list]] = {}
 
-    def _maybe_verify(self, program: Program, feed_names, fetch_names):
+    def _maybe_verify(self, program: Program, feed_names, fetch_names,
+                      wrapper=None, feed_shapes=None):
         """PADDLE_TPU_VALIDATE=off|warn|raise gate, called only at compile
         cache-miss time (default off: unset costs one os.environ read per
         MISS, zero per warm step). Findings go to the journal/metrics
         either way; 'warn' prints them, 'raise' aborts on errors before
-        the XLA compile is attempted."""
+        the XLA compile is attempted.
+
+        ``wrapper`` (the CompiledProgram front door) passes its
+        DistributedStrategy through so the PT04x collective/sharding checks
+        see the mesh the program will actually compile against, and
+        ``PADDLE_TPU_MEM_BUDGET`` (bytes, K/M/G suffixes ok) adds the PT05x
+        static peak-memory planner with the batch read off the real feed
+        shapes. A budget alone (VALIDATE unset) arms the gate in warn
+        mode -- an exported budget must never be silently inert."""
         # shared off|warn|raise parser (observability.journal.mode_env,
         # also behind PADDLE_TPU_OBS_HEALTH): toggle spellings work, typos
         # ('rasie', 'error') raise instead of silently degrading
+        import os
         mode = _obs_journal.mode_env("PADDLE_TPU_VALIDATE")
-        if mode == "off":
+        budget_raw = os.environ.get("PADDLE_TPU_MEM_BUDGET")
+        if mode == "off" and not budget_raw:
             return
         from .. import analysis
+        mem_budget = None
+        if budget_raw:
+            try:
+                mem_budget = analysis.parse_bytes(budget_raw)
+            except ValueError:
+                raise ValueError(
+                    f"PADDLE_TPU_MEM_BUDGET={budget_raw!r} is not a byte "
+                    f"count (use an int or a K/M/G/T suffix)") from None
+        if mode == "off":
+            # a budget alone arms the gate in warn mode: exporting
+            # PADDLE_TPU_MEM_BUDGET and getting silence (or a swallowed
+            # typo) would be the exact silent-OOM failure the planner
+            # exists to prevent
+            mode = "warn"
+        strategy = (wrapper if wrapper is not None and
+                    wrapper.dist_strategy is not None else None)
+        # the batch matters only to the memory planner and the strategy's
+        # divisibility checks; without either, a new feed shape must NOT
+        # re-verify (PR-3 invariant: shape-only changes can't move a
+        # static verdict)
+        batch = (analysis.infer_batch(program, feed_shapes)
+                 if feed_shapes and (strategy is not None or
+                                     mem_budget is not None) else None)
         vkey = (id(program), program._version,
-                tuple(sorted(feed_names)), tuple(fetch_names))
+                tuple(sorted(feed_names)), tuple(fetch_names),
+                wrapper.strategy_signature() if strategy is not None else (),
+                mem_budget, batch)
         prev = self._verified.get(vkey)
         if prev is not None and prev[0] is program:
             # already verified this program version under this run intent
@@ -291,7 +328,9 @@ class Executor:
             counts = analysis.count_by_severity(diags)
         else:
             diags = analysis.verify(program, feed_names=feed_names,
-                                    fetch_names=fetch_names)
+                                    fetch_names=fetch_names,
+                                    strategy=strategy,
+                                    mem_budget=mem_budget, batch=batch)
             self._verified[vkey] = (program, diags)
             while len(self._verified) > self._CACHE_CAP:
                 self._verified.pop(next(iter(self._verified)))
@@ -518,8 +557,14 @@ class Executor:
                               program=f"{id(program)}:v{program._version}")
             # opt-in static verification, before any trace/compile work so
             # PADDLE_TPU_VALIDATE=raise fails with lint diagnostics instead
-            # of a mid-trace stack (and never runs on warm steps)
-            self._maybe_verify(program, list(feed), fetch_names)
+            # of a mid-trace stack (and never runs on warm steps); the
+            # CompiledProgram wrapper hands its strategy to the PT04x
+            # distributed checks, the feed shapes resolve the planner batch
+            # (feed_shapes is reused by the static-memory gauge below)
+            feed_shapes = {k: np.shape(v) for k, v in feed.items()}
+            self._maybe_verify(program, list(feed), fetch_names,
+                               wrapper=compiled_wrapper,
+                               feed_shapes=feed_shapes)
             # recompile detector: which cache-key component changed since this
             # Program last compiled (shape = feed shapes/dtypes, flags = XLA
             # compiler options, strategy = dist strategy, plus version/
@@ -647,7 +692,14 @@ class Executor:
             _obs_cost.update_cost_gauges(compiled, None, label)
             # same deal for the XLA memory footprint of the step, and one
             # occupancy sample so every compile marks the memory timeline
-            _obs_memory.update_program_memory_gauges(compiled, label)
+            xla_parts = _obs_memory.update_program_memory_gauges(compiled,
+                                                                 label)
+            # the static planner's estimate lands beside XLA's exact
+            # answer (+ ratio gauge): its accuracy is observable per
+            # compile (tools/obs_report renders the comparison)
+            _obs_memory.update_static_memory_gauges(
+                program, feed_shapes, list(feed), fetch_names,
+                compiled_wrapper, label, xla_parts)
             _obs_memory.sample_device_memory("compile")
 
         from .. import flags as _flags

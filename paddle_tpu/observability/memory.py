@@ -141,3 +141,36 @@ def update_program_memory_gauges(compiled_step, program: str,
     g("program_output_bytes", "output bytes", program=program).set(
         parts["output_bytes"])
     return parts
+
+
+def update_static_memory_gauges(program_ir, feed_shapes, feed_names,
+                                fetch_names, strategy, program: str,
+                                xla_parts: Optional[Dict[str, float]] = None,
+                                registry: Optional[MetricsRegistry] = None):
+    """Set the *static* peak-memory estimate gauge (analysis/memplan.py:
+    liveness over the IR, sharding divisors + donation applied) next to
+    XLA's exact ``memory_analysis()`` answer, plus their ratio when both
+    exist -- the planner's accuracy is itself observable, per compile.
+    Returns the MemEstimate, or None when the estimate fails (never
+    raises into the compile path)."""
+    registry = registry or REGISTRY
+    try:
+        from ..analysis import memplan
+        batch = (memplan.infer_batch(program_ir, feed_shapes)
+                 if feed_shapes else None)
+        est = memplan.estimate_program_memory(
+            program_ir, feed_names=feed_names, fetch_names=fetch_names,
+            strategy=strategy, batch=batch)
+    except Exception:
+        return None
+    registry.gauge("program_static_peak_bytes",
+                   "static liveness-based peak-memory estimate for the "
+                   "compiled step (analysis/memplan.py)",
+                   program=program).set(float(est.peak_bytes))
+    xla_peak = (xla_parts or {}).get("peak_bytes") or 0.0
+    if xla_peak > 0:
+        registry.gauge("program_static_peak_ratio",
+                       "static estimate / XLA memory_analysis peak (1.0 = "
+                       "planner exact; the planner's accuracy gauge)",
+                       program=program).set(float(est.peak_bytes) / xla_peak)
+    return est

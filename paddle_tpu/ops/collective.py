@@ -13,7 +13,56 @@ bootstrap (SURVEY.md §5.8); multi-host init is jax.distributed (parallel/env.py
 """
 from __future__ import annotations
 
+from typing import Dict, Optional
+
 from ..core.registry import register
+
+#: Communication metadata per op type, consumed by the static analyzer
+#: (analysis/distributed.py, analysis/dataflow.py): which attr names the mesh
+#: axis the op communicates over (and its default), plus the comm semantics
+#: tag. Every rank of the axis must execute the SAME sequence of these ops --
+#: they are synchronization points, never dead code, and never safe inside
+#: control flow whose predicate/trip count can differ across ranks.
+#: ``temporal_pipeline`` is included: its lowering is a shard_map of
+#: ppermute/psum over ``axis`` (ops/pipeline_op.py), so to the analyzer it IS
+#: a collective even though it never appears in this file.
+COLLECTIVE_OPS: Dict[str, dict] = {
+    "c_allreduce_sum": {"comm": "allreduce", "axis_attr": "axis_name",
+                        "default_axis": "dp"},
+    "c_allreduce_max": {"comm": "allreduce", "axis_attr": "axis_name",
+                        "default_axis": "dp"},
+    "c_allreduce_min": {"comm": "allreduce", "axis_attr": "axis_name",
+                        "default_axis": "dp"},
+    "c_allreduce_prod": {"comm": "allreduce", "axis_attr": "axis_name",
+                         "default_axis": "dp"},
+    "c_allreduce_avg": {"comm": "allreduce", "axis_attr": "axis_name",
+                        "default_axis": "dp"},
+    "c_allgather": {"comm": "allgather", "axis_attr": "axis_name",
+                    "default_axis": "dp"},
+    "c_reducescatter": {"comm": "reducescatter", "axis_attr": "axis_name",
+                        "default_axis": "dp"},
+    "c_broadcast": {"comm": "broadcast", "axis_attr": "axis_name",
+                    "default_axis": "dp"},
+    "alltoall": {"comm": "alltoall", "axis_attr": "axis_name",
+                 "default_axis": "dp"},
+    "collective_permute": {"comm": "permute", "axis_attr": "axis_name",
+                           "default_axis": "dp"},
+    "temporal_pipeline": {"comm": "pipeline", "axis_attr": "axis",
+                          "default_axis": "pp"},
+}
+
+
+def is_collective(op_type: str) -> bool:
+    return op_type in COLLECTIVE_OPS
+
+
+def collective_axis(op) -> Optional[str]:
+    """The mesh-axis name an Operator (or anything with ``.type``/``.attr``)
+    communicates over, or None for non-collective ops."""
+    meta = COLLECTIVE_OPS.get(op.type)
+    if meta is None:
+        return None
+    return op.attr(meta["axis_attr"], meta["default_axis"])
 
 
 def _axis_bound(name):

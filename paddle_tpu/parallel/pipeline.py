@@ -52,6 +52,17 @@ def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x, mesh,
     global TRACE_COUNT
     TRACE_COUNT += 1
     tree = jax.tree_util
+    if axis not in mesh.shape:
+        # same failure class the static analyzer flags as PT040: off-mesh
+        # the schedule's ppermute/psum would silently no-op or die mid-trace
+        raise ValueError(
+            f"pipeline axis {axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)}; add it to the DistributedStrategy "
+            f"mesh_shape (the verifier flags this statically as PT040)")
+    if mb_axis is not None and mb_axis not in mesh.shape:
+        raise ValueError(
+            f"microbatch axis {mb_axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)}")
     S = mesh.shape[axis]
     leaves = tree.tree_leaves(x)
     M = leaves[0].shape[0]

@@ -513,8 +513,22 @@ def _count_verify_calls(monkeypatch):
 
 
 def test_validate_unset_adds_no_per_step_work(monkeypatch):
+    journal.clear()  # gate tests elsewhere emit verify events
     monkeypatch.delenv("PADDLE_TPU_VALIDATE", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_MEM_BUDGET", raising=False)
     calls = _count_verify_calls(monkeypatch)
+    # the static memory planner (PT05x) must likewise never run on warm
+    # steps: its always-on comparison gauge fires once per compile MISS
+    # only (same contract as the PR-1 cost gauges)
+    from paddle_tpu.analysis import memplan
+    est_calls = {"n": 0}
+    real_est = memplan.estimate_program_memory
+
+    def counting_est(*a, **kw):
+        est_calls["n"] += 1
+        return real_est(*a, **kw)
+
+    monkeypatch.setattr(memplan, "estimate_program_memory", counting_est)
     main, startup, loss = _gate_program()
     exe = fluid.Executor()
     with fluid.scope_guard(fluid.Scope()):
@@ -524,6 +538,9 @@ def test_validate_unset_adds_no_per_step_work(monkeypatch):
                     fetch_list=[loss])
     assert calls["n"] == 0
     assert not journal.recent(event="verify")
+    # 2 compiles (startup + main), 4 steps: the estimator ran per compile,
+    # never per step
+    assert est_calls["n"] == 2
 
 
 def test_validate_warn_runs_once_per_program_version(monkeypatch):

@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""ci_lint: one CI gate = verifier over the example programs + import hygiene.
+
+Two checks, one command, one exit code:
+
+1. **Program lint**: builds the networks the ``examples/`` scripts train
+   (in-process, no data, no training -- just the graph construction each
+   example's ``main()`` performs) and runs ``paddle_tpu.analysis.verify``
+   over each ``(main, startup)`` pair with full feed/fetch intent, plus the
+   distributed (PT04x) checks under a dp8 strategy. ``--baseline FILE``
+   suppresses accepted findings so CI gates on NEW findings only
+   (``--update-baseline`` regenerates the file, byte-stably).
+
+2. **Unused-import check**: the AST approximation of ruff's F401 used since
+   PR 3 (the ruff binary is not in the container). Walks ``paddle_tpu/``
+   and ``tools/``, honoring the pyproject per-file-ignores: ``__init__.py``
+   facades are exempt, ``# noqa`` lines are skipped.
+
+    python tools/ci_lint.py                          # both checks
+    python tools/ci_lint.py --baseline ci_lint.keys  # gate on new findings
+    python tools/ci_lint.py --selftest               # pinned by the tests
+
+Exit: 0 clean, 1 findings, 2 usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# ------------------------------------------------------- example programs --
+# Builders mirror the graphs the examples/ scripts construct (same layers,
+# same shapes) without their training loops; each returns
+# (main, startup, feed_names, fetch_names).
+
+def _fit_a_line():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [13], "float32")
+        y = fluid.data("y", [1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    return main, startup, ["x", "y"], [loss.name]
+
+
+def _mnist_mlp():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [784], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(img, 200, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+    return main, startup, ["img", "label"], [loss.name, acc.name]
+
+
+def _image_classification():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import vgg
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data("img", [3, 32, 32], "float32")
+        label = fluid.data("label", [1], "int64")
+        loss, acc, _ = vgg.vgg16(img, label, num_classes=10, use_bn=True)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    return main, startup, ["img", "label"], [loss.name, acc.name]
+
+
+def _word2vec():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = [fluid.data(n, [1], "int64")
+                 for n in ("w0", "w1", "w2", "w3")]
+        target = fluid.data("target", [1], "int64")
+        embeds = [fluid.layers.embedding(w, size=[1000, 32],
+                                         param_attr="shared_emb")
+                  for w in words]
+        concat = fluid.layers.concat(embeds, axis=1)
+        hidden = fluid.layers.fc(concat, 64, act="sigmoid")
+        logits = fluid.layers.fc(hidden, 1000)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, target))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return (main, startup, ["w0", "w1", "w2", "w3", "target"], [loss.name])
+
+
+def _understand_sentiment():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [40], "int64")
+        label = fluid.data("label", [1], "int64")
+        emb = fluid.layers.embedding(ids, size=[500, 32])
+        gru_in = fluid.layers.fc(emb, 3 * 32, num_flatten_dims=2)
+        h = fluid.layers.dynamic_gru(gru_in, size=32)
+        pooled = fluid.layers.reduce_max(h, dim=1)
+        logits = fluid.layers.fc(pooled, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    return main, startup, ["ids", "label"], [loss.name]
+
+
+EXAMPLE_PROGRAMS = [
+    ("fit_a_line", _fit_a_line),
+    ("mnist_mlp", _mnist_mlp),
+    ("image_classification", _image_classification),
+    ("word2vec", _word2vec),
+    ("understand_sentiment", _understand_sentiment),
+]
+
+
+def lint_programs(baseline_keys: Dict[str, set], collect) -> int:
+    """Verify every example program (plain + dp8 distributed); returns the
+    finding count after baseline suppression. ``collect(program_name,
+    diag)`` receives kept findings; baseline keys are matched per program
+    name (the same key can be legitimate in one program, new in another)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import analysis
+    dp8 = fluid.DistributedStrategy(mesh_shape={"dp": 8})
+    n = 0
+    for name, build in EXAMPLE_PROGRAMS:
+        main, startup, feeds, fetches = build()
+        for tag, prog, fd, ft, strat in (
+                (name, main, feeds, fetches, None),
+                (f"{name}@startup", startup, None, None, None),
+                (f"{name}@dp8", main, feeds, fetches, dp8)):
+            diags = analysis.verify(prog, feed_names=fd, fetch_names=ft,
+                                    strategy=strat)
+            # the examples are the product's front page: gate on warnings
+            # too, not only errors (info stays report-only)
+            diags = [d for d in diags
+                     if d.severity != analysis.Severity.INFO]
+            kept, _ = analysis.apply_baseline(
+                diags, baseline_keys.get(tag, set()))
+            for d in kept:
+                collect(tag, d)
+                n += 1
+    return n
+
+
+# --------------------------------------------------------- unused imports --
+
+def unused_imports(path: str) -> List[Tuple[int, str]]:
+    """(line, name) for imports never referenced in the module body -- the
+    F401 approximation. Skips ``# noqa`` lines, ``__all__``-listed names,
+    and conventional re-export (``import x as x``)."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"<syntax error: {e.msg}>")]
+    lines = src.splitlines()
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    exported.update(
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant) and
+                        isinstance(e.value, str))
+    imported: Dict[str, Tuple[int, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "__future__":
+                continue  # compiler directive, not a binding (as in F401)
+            line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" in line:
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name.split(".")[0]
+                if alias.asname is not None and alias.asname == alias.name:
+                    continue  # explicit re-export idiom
+                imported[name] = (node.lineno, name)
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    # names referenced in string annotations / docstring-free heuristics:
+    # a bare mention anywhere in the source keeps the import (conservative
+    # -- a checker that can false-positive is a checker people disable)
+    out = []
+    for name, (line, _) in sorted(imported.items(), key=lambda kv: kv[1]):
+        if name in used or name in exported:
+            continue
+        if any(name in ln for i, ln in enumerate(lines)
+               if i != line - 1 and not ln.lstrip().startswith("#")):
+            continue
+        out.append((line, name))
+    return out
+
+
+def lint_imports(roots=("paddle_tpu", "tools")) -> List[str]:
+    """F401 sweep honoring the pyproject per-file-ignores (``__init__.py``
+    facades re-export the fluid surface and are exempt)."""
+    findings = []
+    for root in roots:
+        for dirpath, _, files in os.walk(os.path.join(REPO, root)):
+            for fn in sorted(files):
+                if not fn.endswith(".py") or fn == "__init__.py":
+                    continue
+                path = os.path.join(dirpath, fn)
+                for line, name in unused_imports(path):
+                    rel = os.path.relpath(path, REPO)
+                    findings.append(f"{rel}:{line}: unused import {name!r}")
+    return findings
+
+
+# ----------------------------------------------------------------- driver --
+
+def _load_baseline(path: str) -> Dict[str, set]:
+    """Baseline file: one {"program": tag, "key": [...]} JSON per line."""
+    out: Dict[str, set] = {}
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                d = json.loads(line)
+                out.setdefault(d["program"], set()).add(tuple(d["key"]))
+            except (ValueError, KeyError, TypeError) as e:
+                raise ValueError(f"{path}:{ln}: bad baseline entry: {e}")
+    return out
+
+
+def _write_baseline(path: str, entries: List[Tuple[str, tuple]]) -> int:
+    seen = []
+    for tag, key in entries:
+        e = {"program": tag, "key": list(key)}
+        if e not in seen:
+            seen.append(e)
+    seen.sort(key=lambda e: (e["program"], e["key"]))
+    with open(path, "w") as f:
+        f.write("# tools/ci_lint.py baseline: accepted verifier findings "
+                "per example program\n")
+        for e in seen:
+            f.write(json.dumps(e, sort_keys=True) + "\n")
+    return len(seen)
+
+
+def selftest() -> int:
+    """End-to-end over the real repo + synthetic positives: the repo must
+    be clean, a planted unused import must be caught, and the baseline
+    must suppress exactly what it names."""
+    import tempfile
+    failures = []
+    # 1. the repo's own import hygiene holds (this is the CI assertion)
+    imp = lint_imports()
+    if imp:
+        failures.append("repo has unused imports:\n  " + "\n  ".join(imp))
+    # 2. a planted unused import is caught, a used and a noqa'd one are not
+    with tempfile.TemporaryDirectory() as td:
+        bad = os.path.join(td, "bad.py")
+        with open(bad, "w") as f:
+            f.write("import os\nimport sys  # noqa: F401\n"
+                    "import json\nprint(json.dumps({}))\n")
+        hits = unused_imports(bad)
+        if [(ln, n) for ln, n in hits] != [(1, "os")]:
+            failures.append(f"planted unused import not caught: {hits}")
+    # 3. example programs verify clean (no baseline needed)
+    found: List[Tuple[str, object]] = []
+    n = lint_programs({}, lambda tag, d: found.append((tag, d)))
+    if n:
+        failures.append("example programs have findings:\n  " + "\n  ".join(
+            f"{t}: {d.format()}" for t, d in found))
+    # 4. baseline round trip suppresses a synthetic finding
+    from paddle_tpu.analysis import Diagnostic
+    d = Diagnostic("PT010", "synthetic", block_idx=0, op_idx=1,
+                   op_type="relu")
+    with tempfile.TemporaryDirectory() as td:
+        bpath = os.path.join(td, "base.keys")
+        _write_baseline(bpath, [("progA", d.key())])
+        keys = _load_baseline(bpath)
+        if d.key() not in keys.get("progA", set()) or "progB" in keys:
+            failures.append(f"baseline round trip broken: {keys}")
+    if failures:
+        print("ci_lint selftest: FAILED")
+        for msg in failures:
+            print(" -", msg)
+        return 1
+    print(f"ci_lint selftest: OK ({len(EXAMPLE_PROGRAMS)} example programs "
+          f"x 3 variants verified, import sweep clean)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/ci_lint.py",
+        description="CI lint gate: verifier over example programs + "
+                    "unused-import sweep")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="suppression file of accepted verifier findings "
+                         "(gate on NEW findings only)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings to --baseline and "
+                         "exit 0")
+    ap.add_argument("--skip-imports", action="store_true",
+                    help="run only the program lint")
+    ap.add_argument("--skip-programs", action="store_true",
+                    help="run only the unused-import sweep")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if args.update_baseline and not args.baseline:
+        print("error: --update-baseline needs --baseline FILE")
+        return 2
+    rc = 0
+    if not args.skip_programs:
+        baseline = {}
+        if args.baseline and not args.update_baseline and \
+                os.path.exists(args.baseline):
+            try:
+                baseline = _load_baseline(args.baseline)
+            except (OSError, ValueError) as e:
+                print(f"error: {e}")
+                return 2
+        entries: List[Tuple[str, tuple]] = []
+
+        def collect(tag, d):
+            entries.append((tag, d.key()))
+            print(f"{tag}: {d.format()}")
+
+        n = lint_programs(baseline, collect)
+        if args.update_baseline:
+            wrote = _write_baseline(args.baseline, entries)
+            print(f"baseline: wrote {wrote} entr(ies) to {args.baseline}")
+            return 0
+        if n:
+            print(f"program lint: {n} finding(s) "
+                  f"{'beyond the baseline' if baseline else ''}".strip())
+            rc = 1
+        else:
+            print(f"program lint: clean ({len(EXAMPLE_PROGRAMS)} example "
+                  f"programs x 3 variants)")
+    if not args.skip_imports:
+        imp = lint_imports()
+        for f in imp:
+            print(f)
+        if imp:
+            print(f"unused imports: {len(imp)} finding(s)")
+            rc = 1
+        else:
+            print("unused imports: clean")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -182,7 +182,8 @@ def render_resilience(events: List[dict]) -> str:
 
 _MEMORY_FAMILIES = ("device_memory_bytes_in_use", "device_memory_peak_bytes",
                     "program_peak_bytes", "program_temp_bytes",
-                    "program_argument_bytes", "program_output_bytes")
+                    "program_argument_bytes", "program_output_bytes",
+                    "program_static_peak_bytes", "program_static_peak_ratio")
 
 
 def _gb(v: float) -> str:
@@ -212,17 +213,27 @@ def render_memory(snapshot: dict) -> str:
             lines.append(f"  {dev}: {_gb(s.get('value', 0.0))} {what}")
     progs = {}
     for name in ("program_peak_bytes", "program_temp_bytes",
-                 "program_argument_bytes", "program_output_bytes"):
+                 "program_argument_bytes", "program_output_bytes",
+                 "program_static_peak_bytes", "program_static_peak_ratio"):
         for s in fams.get(name, {}).get("samples", []):
             label = s.get("labels", {}).get("program", "?")
             progs.setdefault(label, {})[name] = s.get("value", 0.0)
     for label, parts in sorted(progs.items()):
         peak = parts.get("program_peak_bytes", 0.0)
-        lines.append(
+        line = (
             f"  program {label}: peak {_gb(peak)} "
             f"(args {_gb(parts.get('program_argument_bytes', 0.0))}, "
             f"temp {_gb(parts.get('program_temp_bytes', 0.0))}, "
             f"out {_gb(parts.get('program_output_bytes', 0.0))})")
+        static = parts.get("program_static_peak_bytes")
+        if static is not None:
+            # the analysis/memplan.py planner's estimate vs XLA's exact
+            # memory_analysis(): the ratio is the planner's accuracy
+            ratio = parts.get("program_static_peak_ratio")
+            line += f"; static plan {_gb(static)}"
+            if ratio:
+                line += f" ({ratio:.2f}x of XLA)"
+        lines.append(line)
     return "\n".join(lines)
 
 
@@ -360,6 +371,8 @@ def selftest() -> int:
     reg.gauge("device_memory_peak_bytes", device="cpu:0").set(2e9)
     reg.gauge("program_peak_bytes", program="1:v0").set(1.5e9)
     reg.gauge("program_temp_bytes", program="1:v0").set(3e8)
+    reg.gauge("program_static_peak_bytes", program="1:v0").set(1.8e9)
+    reg.gauge("program_static_peak_ratio", program="1:v0").set(1.2)
     reg.counter("tensor_nonfinite_total", where="executor").inc()
     reg.counter("anomaly_total", kind="step_time").inc()
     reg.counter("fault_injected_total", kind="nan", site="fetch").inc()
@@ -452,8 +465,9 @@ def selftest() -> int:
                      "PREEMPT at step 7: emergency checkpoint step 6",
                      "1 elastic restart(s)", "rank 1 failed",
                      "fault_injected_total", "steps_skipped_total",
-                     # memory section
+                     # memory section (incl. the static-planner comparison)
                      "cpu:0", "512.000 MB", "peak 1.500 GB",
+                     "static plan 1.800 GB", "(1.20x of XLA)",
                      # timeline section
                      "feed_prep", "dispatch",
                      "counter track 'device_memory_bytes'"):
