@@ -69,7 +69,8 @@ def verify(program: Program,
            fetch_names: Optional[Sequence[str]] = None,
            passes: Optional[Sequence[str]] = None,
            strategy=None, mem_budget: Optional[int] = None,
-           batch: Optional[int] = None) -> List[Diagnostic]:
+           batch: Optional[int] = None,
+           fuse_k: Optional[int] = None) -> List[Diagnostic]:
     """Run the analysis pipeline over ``program``; return sorted findings.
 
     ``feed_names``/``fetch_names`` sharpen the analysis when the run intent
@@ -86,6 +87,11 @@ def verify(program: Program,
     when the estimate exceeds it; ``batch`` resolves dynamic (-1) dims for
     that accounting (without it the planner assumes batch 1 and says so,
     PT052).
+
+    ``fuse_k`` declares fused-megastep intent (Executor.run_fused passes
+    its K): the PT03x recompile lint then reasons about the fused feed
+    signature -- per-step shapes plus a K key component -- and flags the
+    compile-churn modes fusion adds (PT034).
     """
     # supplying a budget or a strategy means the caller wants that check's
     # verdict: engage the owning pass even under an explicit --passes
@@ -102,7 +108,8 @@ def verify(program: Program,
                                        feed_names=feed_names,
                                        fetch_names=fetch_names,
                                        strategy=strategy,
-                                       mem_budget=mem_budget, batch=batch))
+                                       mem_budget=mem_budget, batch=batch,
+                                       fuse_k=fuse_k))
 
 
 def verify_or_raise(program: Program,

@@ -64,7 +64,8 @@ class PassContext:
                  feed_names: Optional[Sequence[str]] = None,
                  fetch_names: Optional[Sequence[str]] = None,
                  strategy=None, mem_budget: Optional[int] = None,
-                 batch: Optional[int] = None):
+                 batch: Optional[int] = None,
+                 fuse_k: Optional[int] = None):
         self.program = program
         # empty == unknown intent, same as None: an executor run with no
         # fetch_list must not flag the whole program dead (PT010), and
@@ -77,6 +78,11 @@ class PassContext:
         self.strategy, self.build_strategy = split_strategy(strategy)
         self.mem_budget = mem_budget
         self.batch = batch
+        # fused-megastep intent: the executor's run_fused gate passes its K
+        # so the PT03x recompile lint reasons about the fused feed
+        # signature (per-step shapes + a K key component), not the stacked
+        # (K, batch, ...) arrays it happens to dispatch
+        self.fuse_k = fuse_k
         self._referencing: Optional[Dict[int, List[Tuple[int, int]]]] = None
         self._roots: Optional[Set[str]] = None
 
@@ -167,9 +173,11 @@ def run_passes(program: Program, passes: Optional[Sequence[str]] = None,
                feed_names: Optional[Sequence[str]] = None,
                fetch_names: Optional[Sequence[str]] = None,
                strategy=None, mem_budget: Optional[int] = None,
-               batch: Optional[int] = None) -> List[Diagnostic]:
+               batch: Optional[int] = None,
+               fuse_k: Optional[int] = None) -> List[Diagnostic]:
     ctx = PassContext(program, feed_names=feed_names, fetch_names=fetch_names,
-                      strategy=strategy, mem_budget=mem_budget, batch=batch)
+                      strategy=strategy, mem_budget=mem_budget, batch=batch,
+                      fuse_k=fuse_k)
     diags: List[Diagnostic] = []
     for name in (passes if passes is not None else default_passes()):
         diags.extend(get_pass(name).run(ctx))

@@ -74,6 +74,27 @@ def _cache_count(kind: str, cache: str, n: int = 1):
                  cache=cache).inc(n)
 
 
+def materialize_fetches(fetches):
+    """Force lazy (device-array) fetches to host numpy.
+
+    The ONE place the fused training loop performs a fetch d2h sync:
+    ``train_from_dataset`` keeps fetches as live device arrays and routes
+    every materialization -- debug ``print_period`` boundaries and the
+    final return -- through here, so debug mode cannot silently re-
+    introduce the per-step sync the fused loop exists to remove.  Counted
+    (``fused_fetch_materializations_total``) so the obs_report Megastep
+    section can report how often an epoch actually synced."""
+    _OBS.counter("fused_fetch_materializations_total",
+                 "lazy-fetch materializations (fetch d2h syncs) in the "
+                 "fused/lazy training loop").inc()
+    return [np.asarray(f) for f in fetches]
+
+
+#: K values the ``fuse_steps.k`` in-loop autotune search measures (on the
+#: live workload itself -- search steps ARE training steps)
+_FUSE_SEARCH_PROBES = 2  # timed megasteps per candidate K
+
+
 class Scope:
     """name -> host/device value store (reference framework/scope.cc)."""
 
@@ -162,6 +183,11 @@ class _CompiledStep:
         # at cache-miss time; backs cost_analysis() and exact compile timing.
         self.executable = None
         self.compile_seconds: Optional[float] = None
+        # fused (lax.scan megastep) entries: substep count and the watched
+        # tensor names behind the in-scan health-flag rows (filled at trace
+        # time; [] when the step compiled without the health reduction)
+        self.fused_k: Optional[int] = None
+        self.health_names: List[str] = []
 
     def cost_analysis(self):
         """XLA optimized-HLO cost analysis for this step (raw jax form: a
@@ -266,7 +292,7 @@ class Executor:
         self._verified: Dict[Tuple, Tuple[Program, list]] = {}
 
     def _maybe_verify(self, program: Program, feed_names, fetch_names,
-                      wrapper=None, feed_shapes=None):
+                      wrapper=None, feed_shapes=None, fuse_k=None):
         """PADDLE_TPU_VALIDATE=off|warn|raise gate, called only at compile
         cache-miss time (default off: unset costs one os.environ read per
         MISS, zero per warm step). Findings go to the journal/metrics
@@ -315,7 +341,7 @@ class Executor:
         vkey = (id(program), program._version,
                 tuple(sorted(feed_names)), tuple(fetch_names),
                 wrapper.strategy_signature() if strategy is not None else (),
-                mem_budget, batch)
+                mem_budget, batch, fuse_k)
         prev = self._verified.get(vkey)
         if prev is not None and prev[0] is program:
             # already verified this program version under this run intent
@@ -330,7 +356,8 @@ class Executor:
             diags = analysis.verify(program, feed_names=feed_names,
                                     fetch_names=fetch_names,
                                     strategy=strategy,
-                                    mem_budget=mem_budget, batch=batch)
+                                    mem_budget=mem_budget, batch=batch,
+                                    fuse_k=fuse_k)
             self._verified[vkey] = (program, diags)
             while len(self._verified) > self._CACHE_CAP:
                 self._verified.pop(next(iter(self._verified)))
@@ -402,6 +429,60 @@ class Executor:
         while len(self._key_parts) > self._CACHE_CAP:
             self._key_parts.pop(next(iter(self._key_parts)))
 
+    def _hoisted(self, program: Program):
+        """Cached host-table hoist entry for ``program``:
+        ``(program, hoisted_program, pending_pulls, pending_pushes)`` --
+        shared by the step path, the fused path's eligibility check, and
+        the guardian (one hoist per program version, LRU-bounded)."""
+        hkey = (id(program), program._version)
+        hcache = getattr(self, "_hoist_cache", None)
+        if hcache is None:
+            hcache = self._hoist_cache = {}
+        entry = hcache.get(hkey)
+        if entry is None or entry[0] is not program:
+            _cache_count("misses", "hoist")
+            from ..ops import host_table as _ht
+            entry = (program,) + _ht.hoist_host_pulls(program)
+            hcache[hkey] = entry
+            while len(hcache) > self._CACHE_CAP:
+                hcache.pop(next(iter(hcache)))
+                _cache_count("evictions", "hoist")
+        else:
+            _cache_count("hits", "hoist")
+        return entry
+
+    def _store_compiled(self, key, compiled):
+        """Insert a freshly compiled entry and LRU-evict past the cap,
+        retiring the evicted entries' anomaly windows and (when no live
+        executor still caches the label) per-program gauges."""
+        self._cache[key] = compiled
+        while len(self._cache) > self._CACHE_CAP:
+            old_key, _ = self._cache.popitem(last=False)
+            _cache_count("evictions", "compile")
+            from ..observability import anomaly as _obs_anomaly
+            _obs_anomaly.DETECTOR.retire(old_key)
+            _retire_program_gauges_if_dead(old_key[0], old_key[1])
+
+    def _post_compile_telemetry(self, compiled, program, label, step_idx,
+                                feed_shapes, feed_names, fetch_names,
+                                wrapper, t0):
+        """Compile-time gauges shared by the step and megastep paths:
+        compile histogram + span, XLA cost/memory gauges, the static
+        planner's estimate beside them, and one occupancy sample."""
+        _OBS.histogram("executor_compile_seconds",
+                       "trace+XLA-compile wall time per cache miss"
+                       ).observe(compiled.compile_seconds)
+        _obs_timeline.record_span("compile", t0, compiled.compile_seconds,
+                                  step=step_idx, program=label)
+        from ..observability import cost as _obs_cost
+        from ..observability import memory as _obs_memory
+        _obs_cost.update_cost_gauges(compiled, None, label)
+        xla_parts = _obs_memory.update_program_memory_gauges(compiled, label)
+        _obs_memory.update_static_memory_gauges(
+            program, feed_shapes, feed_names, fetch_names,
+            wrapper, label, xla_parts)
+        _obs_memory.sample_device_memory("compile")
+
     # -- public API --------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
@@ -427,22 +508,7 @@ class Executor:
         host_pushes = []
         pending_pulls, pending_pushes = [], []
         if compiled_wrapper is None or not compiled_wrapper.dist_strategy:
-            hkey = (id(program), program._version)
-            hcache = getattr(self, "_hoist_cache", None)
-            if hcache is None:
-                hcache = self._hoist_cache = {}
-            entry = hcache.get(hkey)
-            if entry is None or entry[0] is not program:
-                _cache_count("misses", "hoist")
-                from ..ops import host_table as _ht
-                entry = (program,) + _ht.hoist_host_pulls(program)
-                hcache[hkey] = entry
-                while len(hcache) > self._CACHE_CAP:
-                    hcache.pop(next(iter(hcache)))
-                    _cache_count("evictions", "hoist")
-            else:
-                _cache_count("hits", "hoist")
-            _, hprog, pending_pulls, pending_pushes = entry
+            _, hprog, pending_pulls, pending_pushes = self._hoisted(program)
             if pending_pulls:
                 program = hprog
 
@@ -572,27 +638,11 @@ class Executor:
             self._note_compile(program, {
                 "version": key[1], "shape": key[2], "fetches": key[3],
                 "seed": key[4], "flags": key[5], "strategy": key[6],
-                "tuning": key[7]})
+                "fuse": None, "tuning": key[7]})
             compiled = self._compile(program, list(feed), fetch_names,
                                      state_in, state_out,
                                      wrapper=compiled_wrapper)
-            self._cache[key] = compiled
-            while len(self._cache) > self._CACHE_CAP:
-                old_key, _ = self._cache.popitem(last=False)
-                _cache_count("evictions", "compile")
-                # the evicted entry's step-time window dies with it: windows
-                # are per cache entry, so this is unconditional (unlike the
-                # label-shared gauges below)
-                from ..observability import anomaly as _obs_anomaly
-                _obs_anomaly.DETECTOR.retire(old_key)
-                # retire the evicted program's cost gauges with its last
-                # live cache entry: the registry must not grow one series
-                # per program compiled over the life of the process (and a
-                # reused CPython id must not inherit a dead program's
-                # numbers), but other feed-shape entries -- in this
-                # executor or any other live one -- share the label and
-                # must keep their telemetry.
-                _retire_program_gauges_if_dead(old_key[0], old_key[1])
+            self._store_compiled(key, compiled)
         else:
             _cache_count("hits", "compile")
             self._cache.move_to_end(key)
@@ -677,30 +727,12 @@ class Executor:
             # and must HIT, not recompile an identical executable or count
             # a phantom 'tuning' change
             key = self._rehome_tuning_token(key, program)
-            _OBS.histogram("executor_compile_seconds",
-                           "trace+XLA-compile wall time per cache miss"
-                           ).observe(compiled.compile_seconds)
-            _obs_timeline.record_span("compile", t0,
-                                      compiled.compile_seconds,
-                                      step=step_idx, program=label)
-            # timing-independent cost gauges (FLOPs/bytes/intensity) are set
-            # at compile time, unconditionally: they cost one cost_analysis()
-            # per compile and make `bench.py --emit-metrics` carry them
-            # without the journal toggle
-            from ..observability import cost as _obs_cost
-            from ..observability import memory as _obs_memory
-            _obs_cost.update_cost_gauges(compiled, None, label)
-            # same deal for the XLA memory footprint of the step, and one
-            # occupancy sample so every compile marks the memory timeline
-            xla_parts = _obs_memory.update_program_memory_gauges(compiled,
-                                                                 label)
-            # the static planner's estimate lands beside XLA's exact
-            # answer (+ ratio gauge): its accuracy is observable per
-            # compile (tools/obs_report renders the comparison)
-            _obs_memory.update_static_memory_gauges(
-                program, feed_shapes, list(feed), fetch_names,
-                compiled_wrapper, label, xla_parts)
-            _obs_memory.sample_device_memory("compile")
+            # timing-independent cost/memory gauges are set at compile time,
+            # unconditionally (one cost_analysis() per compile); the static
+            # planner's estimate lands beside XLA's exact answer
+            self._post_compile_telemetry(compiled, program, label, step_idx,
+                                         feed_shapes, list(feed),
+                                         fetch_names, compiled_wrapper, t0)
 
         from .. import flags as _flags
         from .. import profiler as _profiler
@@ -830,6 +862,265 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    # -- fused multi-step (megastep) execution -----------------------------------------
+    def _fuse_ineligible(self, program, wrapper=None) -> Optional[str]:
+        """Why ``program`` cannot run fused (None = it can).  Distributed
+        strategies keep the SPMD jit path and host-table programs keep the
+        hoisted pull->step->push schedule -- both per-step host work the
+        scan cannot absorb."""
+        if wrapper is not None and wrapper.dist_strategy:
+            return "CompiledProgram with a DistributedStrategy"
+        _, _, pulls, pushes = self._hoisted(program)
+        if pulls or pushes:
+            return "host-table pulls/pushes (PS schedule)"
+        return None
+
+    def run_fused(self, program: Optional[Program] = None, feeds=None,
+                  fetch_list: Optional[Sequence] = None,
+                  scope: Optional[Scope] = None, return_numpy: bool = False,
+                  stacked_feed: Optional[dict] = None):
+        """Dispatch K training steps as ONE compiled ``lax.scan`` megastep.
+
+        ``feeds`` is a list of K per-step feed dicts (host arrays, stacked
+        here), or pass ``stacked_feed`` = {name: (K, ...) array} when the
+        stacking already happened upstream (the prefetch worker does, so it
+        overlaps device compute).  State threads through the scan carry with
+        the same donated-buffer semantics as ``run``; the program's rng-run
+        counter advances K times (substep i uses counter0+i, exactly the
+        unfused sequence); per-step fetches come back STACKED as (K, ...)
+        arrays -- live device arrays by default (``return_numpy=False``):
+        lazy, not donated, materialize with ``np.asarray`` when needed.
+
+        K=1 delegates to ``run`` (byte-identical to today's loop, pinned by
+        test); the trailing partial chunk of ``train_from_dataset`` goes
+        through the same K=1 path, so fusion adds no padding/masking.
+        Python dispatch, feed device_put and fetch-sync overhead amortize
+        ~K-fold -- the reference's C++ device-worker amortization
+        (executor.py:920) done in the compiler instead.
+        """
+        import jax
+
+        program = program or default_main_program()
+        compiled_wrapper = None
+        if not isinstance(program, Program):
+            compiled_wrapper = program
+            program = compiled_wrapper.program
+        reason = self._fuse_ineligible(program, compiled_wrapper)
+        if reason is not None:
+            raise ValueError(
+                f"run_fused: program cannot run fused ({reason}); run it "
+                f"unfused (fuse_steps=1 / Executor.run)")
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+        scope = scope or global_scope()
+        if stacked_feed is not None:
+            feed = dict(stacked_feed)
+            if not feed:
+                raise ValueError("run_fused needs a non-empty feed")
+            k = int(np.shape(next(iter(feed.values())))[0])
+        else:
+            feeds = list(feeds or [])
+            if not feeds:
+                raise ValueError("run_fused needs a non-empty feeds list")
+            k = len(feeds)
+            feed = {n: np.stack([np.asarray(f[n]) for f in feeds])
+                    for n in feeds[0]}
+        if k == 1:
+            # exactly today's behavior (byte-identical, pinned by test);
+            # re-stack so the (K, ...) fetch contract holds either way
+            one = {n: v[0] for n, v in feed.items()}
+            vals = self.run(program, feed=one, fetch_list=fetch_list,
+                            scope=scope, return_numpy=return_numpy)
+            return [v[None] for v in vals]
+
+        state_in, state_out = self._state_names(program, feed, fetch_names)
+        missing = [n for n in state_in if not scope.has_var(n) or
+                   scope.find_var(n) is None]
+        if missing:
+            raise RuntimeError(
+                f"persistable variables {missing[:8]} are uninitialized; "
+                f"run the startup program first.")
+
+        from .. import tuning as _tuning
+        _tuning.prefetch()
+        from ..observability import health as _obs_health
+        hmode = _obs_health.mode()
+        health_on = hmode != "off"
+        include_state = health_on and _obs_health.include_state()
+        # the feed signature is PER-STEP (leading K stripped): the verifier
+        # and the recompile detector reason about the program's own shapes,
+        # and K gets its own key component below
+        feed_sig = tuple(sorted(
+            (kk, tuple(np.shape(v))[1:], str(np.asarray(v).dtype)
+             if not hasattr(v, "dtype") else str(v.dtype))
+            for kk, v in feed.items()))
+        seed = program.random_seed if program.random_seed is not None else 0
+        from .. import flags as _flagsmod
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               seed, _flagsmod.get_flag("xla_compiler_options"),
+               ("__fused__", k, health_on, include_state),
+               _tuning.state_token())
+        compiled = self._cache.get(key)
+        was_miss = compiled is None
+        if was_miss:
+            _cache_count("misses", "compile")
+            if _rfaults._active:
+                _rfaults.fire("compile",
+                              getattr(program, "_rng_run_counter", 0),
+                              program=f"{id(program)}:v{program._version}")
+            feed_shapes = {kk: tuple(np.shape(v))[1:]
+                           for kk, v in feed.items()}
+            self._maybe_verify(program, list(feed), fetch_names,
+                               wrapper=compiled_wrapper,
+                               feed_shapes=feed_shapes, fuse_k=k)
+            self._note_compile(program, {
+                "version": key[1], "shape": key[2], "fetches": key[3],
+                "seed": key[4], "flags": key[5], "strategy": (),
+                "fuse": key[6], "tuning": key[7]})
+            compiled = self._compile_fused(program, list(feed), fetch_names,
+                                           state_in, state_out, k,
+                                           health_on, include_state)
+            self._store_compiled(key, compiled)
+        else:
+            _cache_count("hits", "compile")
+            self._cache.move_to_end(key)
+
+        label = f"{id(program)}:v{program._version}"
+        step_idx = getattr(program, "_rng_run_counter", 0)
+        _phase = _obs_timeline.phase
+        _t_feed = time.perf_counter()
+        mut_names, ro_names = compiled.state_in_names
+        mut_vals = {n: scope.find_var(n) for n in mut_names}
+        ro_vals = {n: scope.find_var(n) for n in ro_names}
+        feed_vals = {kk: _as_device_array(v) for kk, v in feed.items()}
+        counter = getattr(program, "_rng_run_counter", 0)
+        program._rng_run_counter = counter + k
+        rng = np.uint32(counter)
+        _obs_timeline.record_span("feed_prep", _t_feed,
+                                  time.perf_counter() - _t_feed,
+                                  step=step_idx, program=label, k=k)
+
+        if was_miss:
+            t0 = time.perf_counter()
+            try:
+                compiled.executable = compiled.fn.lower(
+                    mut_vals, ro_vals, feed_vals, rng).compile()
+            except Exception:
+                compiled.executable = None
+            compiled.compile_seconds = time.perf_counter() - t0
+            key = self._rehome_tuning_token(key, program)
+            self._post_compile_telemetry(compiled, program, label, step_idx,
+                                         feed_shapes, list(feed),
+                                         fetch_names, compiled_wrapper, t0)
+
+        from .. import flags as _flags
+        obs_on = _obs_journal.enabled()
+        step_fn = compiled.executable if compiled.executable is not None \
+            else compiled.fn
+        if _rfaults._active:
+            _rfaults.fire("dispatch", step_idx, program=label)
+        t_run = time.perf_counter()
+        fallback_retraced = False
+        with _phase("megastep", step=step_idx, program=label, k=k):
+            with _phase("dispatch", step=step_idx, program=label, k=k):
+                try:
+                    fetches, new_state, hflags = step_fn(
+                        mut_vals, ro_vals, feed_vals, rng)
+                except TypeError:
+                    if step_fn is compiled.fn:
+                        raise
+                    compiled.executable = None
+                    fallback_retraced = True
+                    fetches, new_state, hflags = compiled.fn(
+                        mut_vals, ro_vals, feed_vals, rng)
+            if _flags.get_flag("benchmark"):
+                with _phase("fetch_sync", step=step_idx, program=label):
+                    jax.block_until_ready(new_state)
+            elif obs_on:
+                with _phase("fetch_sync", step=step_idx, program=label):
+                    jax.block_until_ready((fetches, new_state))
+        run_s = time.perf_counter() - t_run
+        if was_miss and compiled.executable is None:
+            key = self._rehome_tuning_token(key, program)
+        _OBS.histogram("executor_run_seconds",
+                       "Executor.run dispatch/step wall time").observe(run_s)
+        _OBS.counter("executor_runs_total", "Executor.run calls").inc(k)
+
+        faults_fired = False
+        if _rfaults._active:
+            fired0 = sum(f.fired for f in _rfaults._active)
+            for i in range(k):
+                _rfaults.fire("fetch", counter + i, program=label)
+            rows = [[f[i] for f in fetches] for i in range(k)]
+            for i in range(k):
+                rows[i], new_state = _rfaults.corrupt_step(
+                    counter + i, list(fetch_names), rows[i], new_state,
+                    program=label)
+            if sum(f.fired for f in _rfaults._active) != fired0:
+                faults_fired = True
+                # restack the (possibly corrupted) substep rows; chaos
+                # mode only -- the clean path never materializes here
+                fetches = [np.stack([np.asarray(rows[i][j])
+                                     for i in range(k)])
+                           for j in range(len(fetch_names))]
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if health_on:
+            if faults_fired:
+                # injected corruption happened AFTER the in-scan flags were
+                # computed: scan the corrupted host values instead (chaos
+                # path; attribution loses the substep, keeps the var --
+                # each stacked (K, ...) fetch is scanned whole)
+                named = list(zip(fetch_names, fetches))
+                if include_state:
+                    named += list(new_state.items())
+                _obs_health.check(named, label, where="executor",
+                                  health_mode=hmode)
+            elif hflags is not None:
+                flag_rows = _obs_health.read_flags(hflags)
+                _obs_health.check_flag_matrix(
+                    flag_rows, compiled.health_names, label,
+                    where="executor", health_mode=hmode, step0=counter)
+        if _flags.get_flag("check_nan_inf"):
+            bad = [n for n, v in new_state.items()
+                   if np.issubdtype(np.asarray(v).dtype, np.floating) and
+                   not np.isfinite(np.asarray(v)).all()]
+            if bad:
+                raise FloatingPointError(
+                    f"NaN/Inf detected in state vars {bad[:5]} after fused "
+                    f"run (FLAGS_check_nan_inf)")
+        amortized = run_s / k
+        if (not was_miss and not fallback_retraced
+                and (obs_on or _flags.get_flag("benchmark"))):
+            # anomaly windows are keyed per (cache entry, K): the key holds
+            # the fuse marker, so a K=8 megastep's amortized per-substep
+            # time never shares a median with K=1 steps of the same program
+            from ..observability import anomaly as _obs_anomaly
+            _obs_anomaly.DETECTOR.observe(label, amortized, key=key)
+        if obs_on:
+            self._obs_step = getattr(self, "_obs_step", 0) + 1
+            from ..observability import memory as _obs_memory
+            if self._obs_step % _obs_memory.sample_interval() == 0:
+                _obs_memory.sample_device_memory("interval")
+            with _phase("journal", step=step_idx, program=label):
+                _obs_journal.emit({
+                    "event": "megastep", "program": id(program),
+                    "version": program._version,
+                    "cache": "miss" if was_miss else "hit",
+                    "k": k, "step0": counter,
+                    "compile_ms": (round(compiled.compile_seconds * 1e3, 3)
+                                   if was_miss and compiled.compile_seconds
+                                   is not None else None),
+                    "run_ms": round(run_s * 1e3, 3),
+                    "amortized_ms": round(amortized * 1e3, 3),
+                    "feed": {n: [list(shape), dtype]
+                             for n, shape, dtype in feed_sig},
+                    "fetch": list(fetch_names),
+                })
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
     def close(self):
         # same invariant as the eviction path: dropped cache entries take
         # their anomaly windows with them unconditionally, and per-program
@@ -859,7 +1150,7 @@ class Executor:
             self._closing = False
 
     @staticmethod
-    def _prefetch_batches(batches, depth):
+    def _prefetch_batches(batches, depth, fuse: int = 1):
         """Host-side double buffering (VERDICT r4 #5): a worker thread runs
         the dataset's parse/slice/stack generator ahead of the device loop
         through a bounded queue, so batch k+1's host work overlaps batch k's
@@ -868,7 +1159,17 @@ class Executor:
         (trainer.h:64, hogwild_worker.cc: N device-worker threads against
         the DataFeed queue) in its TPU-sized form: one parse thread is
         enough because the device side is a single jitted step stream.
-        Single worker -> batch order is preserved."""
+        Single worker -> batch order is preserved.
+
+        ``fuse`` > 1 additionally groups every ``fuse`` consecutive batches
+        and STACKS them into one (K, ...) super-batch INSIDE the worker
+        (host np.stack, overlapped with device compute like the parse);
+        items then arrive tagged ``("mega", stacked_feed, k)`` or
+        ``("one", feed)`` -- the trailing partial group (and any group whose
+        shapes do not stack, e.g. an odd last batch) degrades to singles,
+        the K=1 remainder path. ``fuse=1`` yields raw feed dicts, exactly
+        the historical contract (the guardian's unfused epoch relies on
+        it)."""
         import queue
         import threading
 
@@ -888,6 +1189,16 @@ class Executor:
                     continue
             return False
 
+        def _stacked(group):
+            """One ("mega", ...) item when the group stacks (uniform keys
+            and per-slot shapes), else the singles unchanged."""
+            shapes = [{n: np.shape(v) for n, v in g.items()} for g in group]
+            if len(group) > 1 and all(s == shapes[0] for s in shapes[1:]):
+                return [("mega",
+                         {n: np.stack([np.asarray(g[n]) for g in group])
+                          for n in group[0]}, len(group))]
+            return [("one", g) for g in group]
+
         # NOTE (measured, round 5): moving jax.device_put into this worker
         # was tried and reverted -- h2d from a side thread contends on the
         # relay link (one epoch spiked 4x). The worker overlaps the pure
@@ -895,9 +1206,22 @@ class Executor:
         # thread.
         def worker():
             try:
-                for item in batches:
-                    if not _put(item):
-                        return
+                if fuse <= 1:
+                    for item in batches:
+                        if not _put(item):
+                            return
+                else:
+                    group = []
+                    for item in batches:
+                        group.append(item)
+                        if len(group) == fuse:
+                            for it in _stacked(group):
+                                if not _put(it):
+                                    return
+                            group = []
+                    for g in group:  # trailing partial chunk: K=1 path
+                        if not _put(("one", g)):
+                            return
                 _put(done)
             except BaseException as e:  # surfaced in the consumer thread
                 _put(e)
@@ -912,10 +1236,16 @@ class Executor:
         try:
             while True:
                 # the flight recorder sees host-input stalls as feed_wait
-                # spans: a device-bound epoch shows ~zero wait, a parse-bound
-                # one shows the dataset thread starving the step loop
-                with _obs_timeline.phase("feed_wait", cat="dataset"):
-                    item = q.get()
+                # spans -- but only when the queue actually RUNS DRY: the
+                # unconditional span (append + histogram observe) on every
+                # hot get was measured as part of the negative prefetch
+                # saving on the DeepFM e2e path (r6); a stocked queue now
+                # costs one get_nowait
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    with _obs_timeline.phase("feed_wait", cat="dataset"):
+                        item = q.get()
                 if item is done:
                     break
                 if isinstance(item, BaseException):
@@ -931,42 +1261,255 @@ class Executor:
         return max(2, int(thread) or
                    int(getattr(dataset, "thread_num", 0) or 0))
 
+    def _fuse_params(self, feed, fetch_names) -> dict:
+        """The ``fuse_steps.k`` TunableChoice params for one workload: the
+        per-step feed signature plus the fetch count (what the megastep's
+        host-overhead amortization actually depends on)."""
+        return {"feed": sorted(
+                    (n, [int(d) for d in np.shape(v)],
+                     str(v.dtype) if hasattr(v, "dtype")
+                     else str(np.asarray(v).dtype))
+                    for n, v in feed.items()),
+                "fetches": len(fetch_names)}
+
+    def _resolve_fuse_steps(self, batches, fetch_names):
+        """``fuse_steps=0``: consult the ``fuse_steps.k`` choice point.
+        Peeks the first batch (its shapes key the decision), returns
+        ``(k, batches-with-the-peek-restored, params-or-None)``; a non-None
+        params means PADDLE_TPU_TUNE=search with no cached decision -- the
+        caller runs the in-loop search on the live workload."""
+        import itertools
+        from .. import tuning as _tuning
+        from ..tuning import cache as _tcache
+        it = iter(batches)
+        try:
+            first = next(it)
+        except StopIteration:
+            return 1, iter(()), None
+        chained = itertools.chain([first], it)
+        tmode = _tcache.mode()
+        if tmode == "off":
+            return 1, chained, None
+        fetch_strs = [v.name if isinstance(v, Variable) else str(v)
+                      for v in fetch_names]
+        params = self._fuse_params(first, fetch_strs)
+        choice = _tuning.get_choice("fuse_steps.k")
+        cached = _tcache.CACHE.get(choice.key(params))
+        k = int(_tuning.decide("fuse_steps.k", params, allow_search=False))
+        if cached is not None or tmode != "search":
+            return k, chained, None
+        return 1, chained, params
+
+    def _fused_search_epoch(self, program, batches, depth, fetch_list,
+                            scope, params, step_cb):
+        """In-loop ``fuse_steps.k`` search: measure candidate K values on
+        the LIVE workload (search megasteps ARE training steps -- every
+        update commits normally), persist the winner through the PR-4
+        decision cache, and finish the epoch fused at the winning K.
+
+        Measurement discipline per candidate: one untimed warm megastep
+        (absorbs the compile), then ``_FUSE_SEARCH_PROBES`` timed megasteps
+        closed by a relay-safe one-element d2h read; candidates are visited
+        ascending and the search simply stops early (persisting what it
+        measured) if the epoch runs out of batches."""
+        import time as _time
+        from .. import tuning as _tuning
+        from ..tuning.measure import _force
+        choice = _tuning.get_choice("fuse_steps.k")
+        cands = sorted(int(c) for c in choice.candidates(params))
+        it = iter(self._prefetch_batches(batches, depth))
+        timings: Dict[str, dict] = {}
+        t_search = _time.perf_counter()
+        prog_obj = (program.program if program is not None and
+                    not isinstance(program, Program)
+                    else (program or default_main_program()))
+        scope_obj = scope or global_scope()
+
+        def sync_probe(vals, feed):
+            """Relay-safe segment close: one-element d2h read of a fetch,
+            else of a written state var."""
+            if vals:
+                _force(vals)
+                return
+            _, written = self._state_names(prog_obj, feed, ())
+            for n in written:
+                v = scope_obj.find_var(n)
+                if v is not None:
+                    _force(v)
+                    return
+
+        def run_chunk(feeds):
+            if len(feeds) == 1:
+                vals = self.run(program, feed=feeds[0],
+                                fetch_list=fetch_list, scope=scope,
+                                return_numpy=False)
+                step_cb(vals, 1, fused=False)
+            else:
+                vals = self.run_fused(program, feeds=feeds,
+                                      fetch_list=fetch_list, scope=scope)
+                step_cb(vals, len(feeds), fused=True)
+            return vals
+
+        exhausted = False
+        for cand in cands:
+            for probe in range(_FUSE_SEARCH_PROBES + 1):  # +1 warm/compile
+                feeds = []
+                for _ in range(cand):
+                    try:
+                        feeds.append(next(it))
+                    except StopIteration:
+                        exhausted = True
+                        break
+                if len(feeds) < cand:
+                    for f in feeds:       # leftover singles still train
+                        run_chunk([f])
+                    break
+                t0 = _time.perf_counter()
+                vals = run_chunk(feeds)
+                sync_probe(vals, feeds[0])
+                dt = _time.perf_counter() - t0
+                if probe > 0:
+                    rec = timings.setdefault(str(cand), {"runs_ms": []})
+                    rec["runs_ms"].append(dt / cand * 1e3)
+            if str(cand) in timings:
+                runs = sorted(timings[str(cand)]["runs_ms"])
+                timings[str(cand)]["run_ms"] = runs[len(runs) // 2]
+            if exhausted:
+                break
+        measured = {c: t["run_ms"] for c, t in timings.items()
+                    if "run_ms" in t}
+        winner = (int(min(measured, key=measured.get)) if measured else 1)
+        _tuning.record_decision(
+            "fuse_steps.k", params, winner, timings=timings,
+            search_seconds=_time.perf_counter() - t_search,
+            measured=bool(measured))
+        if exhausted:
+            return
+        # finish the epoch fused at the winner (consumer-side grouping:
+        # the prefetch worker was started unstacked for the search)
+        feeds = []
+        for feed in it:
+            feeds.append(feed)
+            if len(feeds) == winner:
+                run_chunk(feeds)
+                feeds = []
+        for f in feeds:
+            run_chunk([f])
+
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           fuse_steps: int = 1, return_numpy: bool = True):
         """Run one epoch over a Dataset (reference executor.py:920
         train_from_dataset, which spun up C++ device-worker threads; here
         the dataset generator feeds the jitted step loop through a
         prefetch thread -- see _prefetch_batches -- and device-side
         parallelism is XLA's async dispatch). `thread` sizes the prefetch
         queue depth (reference semantics: worker-thread count); 0 uses the
-        dataset's thread_num, floored at 2 for double buffering."""
+        dataset's thread_num, floored at 2 for double buffering.
+
+        ``fuse_steps=K`` (default 1 = exactly the historical loop, pinned
+        byte-identical) compiles K steps into one ``lax.scan`` megastep
+        (:meth:`run_fused`): the prefetch worker stacks K batches into a
+        super-batch, one dispatch covers K steps, and the trailing partial
+        chunk runs through the K=1 path. ``fuse_steps=0`` consults the
+        ``fuse_steps.k`` autotuner choice (PADDLE_TPU_TUNE=search measures
+        candidate K values on the live workload and persists the winner).
+        Fetches are LAZY in this loop: materialized (one counted d2h sync)
+        only at debug ``print_period`` boundaries and -- when
+        ``return_numpy`` (default) -- on return; ``return_numpy=False``
+        returns the last step's fetches as live device arrays (not
+        donated)."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset (use "
                              "fluid.DatasetFactory().create_dataset(...))")
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [v.name if isinstance(v, Variable) else
                                     str(v) for v in fetch_list]
+        k = int(fuse_steps)
+        if k < 0:
+            raise ValueError("fuse_steps must be >= 0 (0 = autotune)")
+        wrapper = (program if program is not None and
+                   not isinstance(program, Program) else None)
+        prog = (wrapper.program if wrapper is not None
+                else (program or default_main_program()))
+        if k != 1:
+            reason = self._fuse_ineligible(prog, wrapper)
+            if reason is not None:
+                import warnings
+                warnings.warn(
+                    f"train_from_dataset(fuse_steps={fuse_steps}): "
+                    f"{reason}; running unfused", stacklevel=2)
+                k = 1
         depth = self._prefetch_depth(thread, dataset)
-        last = None
-        for i, feed in enumerate(self._prefetch_batches(
-                dataset._iter_batches(), depth)):
-            vals = self.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
-            last = vals
-            if debug and fetch_list and i % max(print_period, 1) == 0:
-                msg = ", ".join(f"{n}={np.asarray(v).reshape(-1)[0]:.6g}"
-                                for n, v in zip(fetch_info, vals))
-                print(f"[train_from_dataset] batch {i}: {msg}")
-        return last
+        batches = dataset._iter_batches()
+        search_params = None
+        if k == 0:
+            k, batches, search_params = self._resolve_fuse_steps(
+                batches, fetch_list)
+
+        state = {"last": None, "fused": False, "i": 0}
+        period = max(print_period, 1)
+
+        def _dbg(vals_np, j):
+            msg = ", ".join(f"{n}={np.asarray(v).reshape(-1)[0]:.6g}"
+                            for n, v in zip(fetch_info, vals_np))
+            print(f"[train_from_dataset] batch {j}: {msg}")
+
+        def step_cb(vals, kk, fused):
+            i = state["i"]
+            if debug and fetch_list:
+                hits = [j for j in range(i, i + kk) if j % period == 0]
+                if hits:
+                    # ONE materialization per boundary-crossing chunk --
+                    # debug mode must not re-introduce the per-step sync
+                    vals_np = materialize_fetches(vals)
+                    for j in hits:
+                        _dbg([v[j - i] for v in vals_np] if fused
+                             else vals_np, j)
+            state["last"], state["fused"] = vals, fused
+            state["i"] = i + kk
+
+        if search_params is not None:
+            self._fused_search_epoch(program, batches, depth, fetch_list,
+                                     scope, search_params, step_cb)
+        elif k > 1:
+            for item in self._prefetch_batches(batches, depth, fuse=k):
+                if item[0] == "mega":
+                    vals = self.run_fused(program, stacked_feed=item[1],
+                                          fetch_list=fetch_list,
+                                          scope=scope)
+                    step_cb(vals, item[2], fused=True)
+                else:
+                    vals = self.run(program, feed=item[1],
+                                    fetch_list=fetch_list, scope=scope,
+                                    return_numpy=False)
+                    step_cb(vals, 1, fused=False)
+        else:
+            for feed in self._prefetch_batches(batches, depth):
+                vals = self.run(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope, return_numpy=False)
+                step_cb(vals, 1, fused=False)
+        last = state["last"]
+        if last is None:
+            return None
+        if state["fused"]:
+            last = [v[-1] for v in last]  # the LAST substep's fetches
+        if return_numpy:
+            return materialize_fetches(last) if last else []
+        return list(last)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           return_numpy: bool = True):
         """Reference executor.py:1012: same loop, eval-style (fetch-pruned so
         optimizer ops do not run -- which is why fetch_list is required: with
         nothing to prune toward, the full program incl. optimizer updates
-        would execute)."""
+        would execute).  Fetches are lazy like the train loop: debug
+        printing materializes (one counted d2h sync) only at
+        ``print_period`` boundaries, and ``return_numpy=False`` returns the
+        last batch's fetches as live device arrays."""
         if dataset is None:
             raise ValueError("infer_from_dataset needs a dataset")
         if not fetch_list:
@@ -984,12 +1527,15 @@ class Executor:
         for i, feed in enumerate(self._prefetch_batches(
                 dataset._iter_batches(), depth)):
             last = self.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope, use_prune=True)
+                            scope=scope, use_prune=True, return_numpy=False)
             if debug and i % max(print_period, 1) == 0:
+                vals_np = materialize_fetches(last)
                 msg = ", ".join(f"{n}={np.asarray(v).reshape(-1)[0]:.6g}"
-                                for n, v in zip(fetch_info, last))
+                                for n, v in zip(fetch_info, vals_np))
                 print(f"[infer_from_dataset] batch {i}: {msg}")
-        return last
+        if last is None:
+            return None
+        return materialize_fetches(last) if return_numpy else list(last)
 
     # -- internals ---------------------------------------------------------------------
     def _state_names(self, program: Program, feed: dict, fetch_names=()):
@@ -1119,6 +1665,91 @@ class Executor:
             jit_kw["compiler_options"] = _xla_options()
         jitted = jax.jit(step, donate_argnums=(0,), **jit_kw)
         return _CompiledStep(jitted, (mut_names, ro_names), state_out, fetch_names)
+
+    def _compile_fused(self, program: Program, feed_names, fetch_names,
+                       state_in, state_out, k: int, health_on: bool,
+                       include_state: bool):
+        """Compile K training steps as one ``lax.scan``-of-step megastep.
+
+        The scan body is the SAME trace the single step compiles (same
+        ``trace_block``, same per-substep ``fold_in`` rng), so fused and
+        unfused runs are numerically identical; mutable state threads
+        through the carry (donated), read-only state rides as scan
+        constants, and the per-step fetches stack into (K, ...) outputs.
+        Write-only persistables (in ``state_out`` but not ``state_in``)
+        ride the stacked outputs and commit their LAST substep's value.
+        With ``health_on`` the PR-2 watchdog's any-nonfinite reduction runs
+        INSIDE the scan, yielding one (K, n_watch) packed-bool matrix --
+        a single small d2h read per megastep regardless of K."""
+        import jax
+        import jax.numpy as jnp
+
+        block = program.global_block()
+        mut_names = [n for n in state_in if n in state_out]
+        ro_names = [n for n in state_in if n not in state_out]
+        tail_names = [n for n in state_out if n not in mut_names]
+        seed = program.random_seed if program.random_seed is not None else 0
+        health_names: List[str] = []
+
+        def substep(mut_state, ro_state, feed, rng_counter):
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), rng_counter)
+            env: Dict[str, Any] = {}
+            env.update(mut_state)
+            env.update(ro_state)
+            env.update(feed)
+
+            def block_runner(idx, sub_env, key=rng):
+                sub_block = program.blocks[idx]
+                merged = dict(env)
+                merged.update(sub_env)
+                return trace_block(sub_block, merged, key, block_runner)
+
+            trace_block(block, env, rng, block_runner)
+            fetches = []
+            for n in fetch_names:
+                if n not in env:
+                    raise KeyError(
+                        f"fetch variable {n!r} was not produced by the "
+                        f"program and is not in the feed/scope")
+                fetches.append(env[n])
+            new_state = {n: env[n] for n in state_out if n in env}
+            return fetches, new_state
+
+        def megastep(mut_state, ro_state, feeds, rng_counter0):
+            def body(carry, feed):
+                mut, cnt = carry
+                fetches, new_state = substep(mut, ro_state, feed, cnt)
+                new_mut = {n: new_state.get(n, mut[n]) for n in mut_names}
+                tail = {n: new_state[n] for n in tail_names
+                        if n in new_state}
+                ys = {"fetch": fetches, "tail": tail}
+                if health_on:
+                    from ..observability import health as _obs_health
+                    named = list(zip(fetch_names, fetches))
+                    if include_state:
+                        named += sorted(new_state.items())
+                    names, flags = _obs_health.nonfinite_flags(named)
+                    health_names[:] = names
+                    ys["health"] = (flags if flags is not None
+                                    else jnp.zeros((0,), bool))
+                return (new_mut, cnt + jnp.uint32(1)), ys
+
+            carry0 = (mut_state, jnp.asarray(rng_counter0, jnp.uint32))
+            (mut, _), ys = jax.lax.scan(body, carry0, feeds)
+            new_state = dict(mut)
+            for n, v in ys["tail"].items():
+                new_state[n] = v[-1]
+            return ys["fetch"], new_state, ys.get("health")
+
+        jit_kw = {}
+        if _xla_options():
+            jit_kw["compiler_options"] = _xla_options()
+        jitted = jax.jit(megastep, donate_argnums=(0,), **jit_kw)
+        cs = _CompiledStep(jitted, (mut_names, ro_names), state_out,
+                           fetch_names)
+        cs.fused_k = k
+        cs.health_names = health_names  # filled when the trace runs
+        return cs
 
 
 # Convenience used widely in reference-style user code.

@@ -45,6 +45,35 @@ def prefetch() -> None:
         cache.CACHE.load()
 
 
+def record_decision(choice_id: str, params: dict, winner,
+                    timings: Optional[dict] = None,
+                    search_seconds: Optional[float] = None,
+                    measured: bool = True) -> dict:
+    """Persist an EXTERNALLY measured decision for ``choice_id``.
+
+    The door for choice points whose candidates cannot be measured in
+    ``measure.search``'s isolated jit -- ``fuse_steps.k`` is measured by
+    ``Executor.train_from_dataset`` on the live workload (the search
+    megasteps are real training steps) and recorded here.  Journals the
+    same auditable ``autotune`` event a harness search would."""
+    import time as _time
+    from ..observability import journal as _journal
+    ch = get_choice(choice_id)
+    key = ch.key(params)
+    rec = {"choice": choice_id, "winner": ch.encode(winner),
+           "measured": bool(measured), "timings": dict(timings or {}),
+           "search_seconds": (round(float(search_seconds), 6)
+                              if search_seconds is not None else None),
+           "ts": _time.time()}
+    cache.CACHE.put(key, rec)
+    _journal.emit({"event": "autotune", "choice": choice_id, "key": key,
+                   "winner": rec["winner"], "measured": rec["measured"],
+                   "timings": rec["timings"],
+                   "search_ms": (round(float(search_seconds) * 1e3, 3)
+                                 if search_seconds is not None else None)})
+    return rec
+
+
 #: the measured ROOFLINE_RESNET.md bottleneck shapes (M, K, N) of the
 #: ResNet-50 1x1 convs at batch 128, NHWC -- the conv+BN suite
 RESNET_CONV_BN_SHAPES = (

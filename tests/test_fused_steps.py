@@ -1,0 +1,447 @@
+"""Fused multi-step (megastep) execution: K training steps in one lax.scan.
+
+ISSUE 8 pins: fused-vs-unfused BYTE-IDENTICAL state after N = K*m + r steps
+(covering the trailing K=1 remainder path), fuse_steps=1 == today's loop
+exactly, chaos (injected nan + transient exc) under fusion with StepGuardian
+rollback restoring to a megastep boundary, and the zero-overhead guard:
+obs-off fused runs open no files and add no d2h syncs beyond the one packed
+health read when (and only when) the watchdog is armed.
+"""
+import builtins
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import executor as executor_mod
+from paddle_tpu.observability import health, journal
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.recovery import StepGuardian
+
+
+class _ListDataset:
+    """Minimal dataset stub: train_from_dataset only uses _iter_batches."""
+
+    def __init__(self, batches):
+        self.batches = batches
+        self.thread_num = 0
+
+    def _iter_batches(self):
+        yield from self.batches
+
+
+def _train_program(dim=8, classes=4, seed=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [dim], "float32")
+        label = fluid.data("label", [1], "int64")
+        h = fluid.layers.fc(x, dim, act="relu")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, classes), label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, dim=8, classes=4, bs=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.rand(bs, dim).astype("float32"),
+             "label": rng.randint(0, classes, (bs, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _epoch(main, startup, loss, batches, fuse_steps, **kw):
+    main._rng_run_counter = 0
+    startup._rng_run_counter = 0
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        last = exe.train_from_dataset(main, _ListDataset(batches),
+                                      fetch_list=[loss],
+                                      fuse_steps=fuse_steps, **kw)
+        w = np.asarray(scope.find_var("fc_0.w_0"))
+    return last, w
+
+
+# ------------------------------------------------------- numeric identity --
+
+@pytest.mark.smoke
+def test_fused_matches_unfused_byte_identical_with_remainder():
+    """N = K*m + r steps (11 = 4*2 + 3): the fused loop (2 megasteps + 3
+    K=1 remainder steps) commits byte-identical state AND returns the same
+    last-step fetches as today's loop."""
+    main, startup, loss = _train_program()
+    batches = _batches(11)
+    l1, w1 = _epoch(main, startup, loss, batches, fuse_steps=1)
+    l4, w4 = _epoch(main, startup, loss, batches, fuse_steps=4)
+    assert w1.tobytes() == w4.tobytes()
+    assert np.asarray(l1[0]).tobytes() == np.asarray(l4[0]).tobytes()
+    assert main._rng_run_counter == 11  # substep rng sequence preserved
+
+
+def test_fuse_steps_1_is_exactly_todays_loop(monkeypatch):
+    """fuse_steps=1 (the default) never touches the fused path: byte-
+    identical output with run_fused forbidden outright."""
+    main, startup, loss = _train_program(seed=5)
+    batches = _batches(6)
+    _, w_base = _epoch(main, startup, loss, batches, fuse_steps=1)
+
+    def boom(*a, **k):
+        raise AssertionError("fuse_steps=1 must not reach run_fused")
+
+    monkeypatch.setattr(fluid.Executor, "run_fused", boom)
+    _, w_again = _epoch(main, startup, loss, batches, fuse_steps=1)
+    assert w_base.tobytes() == w_again.tobytes()
+
+
+def test_run_fused_public_api_contract():
+    """run_fused returns STACKED (K, ...) fetches -- live device arrays by
+    default, numpy on request -- and advances the rng counter K times."""
+    main, startup, loss = _train_program(seed=7)
+    feeds = _batches(3)
+    main._rng_run_counter = 0
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lazy = exe.run_fused(main, feeds=feeds, fetch_list=[loss])
+        assert not isinstance(lazy[0], np.ndarray)  # live device array
+        assert np.shape(lazy[0])[0] == 3  # (K, ...) stacked
+        host = exe.run_fused(main, feeds=feeds, fetch_list=[loss],
+                             return_numpy=True)
+        assert isinstance(host[0], np.ndarray)
+    assert main._rng_run_counter == 6
+    # K=1 delegates to the unfused step (byte-identical path), re-stacked
+    main._rng_run_counter = 0
+    exe2 = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup)
+        one = exe2.run_fused(main, feeds=feeds[:1], fetch_list=[loss],
+                             return_numpy=True)
+        assert np.shape(one[0])[0] == 1
+        assert not any(k[6] and k[6][0] == "__fused__" and k[6][1] == 1
+                       for k in exe2._cache if isinstance(k[6], tuple))
+
+
+def test_run_fused_rejects_dist_strategy():
+    main, startup, loss = _train_program(seed=9)
+    cp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="DistributedStrategy"):
+            exe.run_fused(cp, feeds=_batches(2), fetch_list=[loss])
+
+
+def test_train_from_dataset_return_numpy_false_is_lazy():
+    """Satellite: return_numpy=False threads through the hot loop -- the
+    returned last-step fetches are live device arrays, not host copies."""
+    main, startup, loss = _train_program(seed=11)
+    last, _ = _epoch(main, startup, loss, _batches(5), fuse_steps=2,
+                     return_numpy=False)
+    assert not isinstance(last[0], np.ndarray)
+    last1, _ = _epoch(main, startup, loss, _batches(5), fuse_steps=1,
+                      return_numpy=False)
+    assert not isinstance(last1[0], np.ndarray)
+
+
+# ------------------------------------------------------------ prefetch -----
+
+def test_prefetch_worker_stacks_and_degrades_remainder():
+    """fuse=3 over 8 batches: two stacked ("mega", ...) super-batches built
+    IN the worker, then two K=1 singles; order preserved."""
+    batches = _batches(8)
+    items = list(fluid.Executor._prefetch_batches(iter(batches), 2, fuse=3))
+    tags = [it[0] for it in items]
+    assert tags == ["mega", "mega", "one", "one"]
+    stacked = items[0][1]
+    assert items[0][2] == 3
+    np.testing.assert_array_equal(
+        stacked["x"], np.stack([b["x"] for b in batches[:3]]))
+    np.testing.assert_array_equal(items[2][1]["x"], batches[6]["x"])
+    # a shape-breaking batch in a group degrades that group to singles
+    odd = _batches(2) + [{"x": np.zeros((2, 8), "float32"),
+                          "label": np.zeros((2, 1), "int64")}]
+    items = list(fluid.Executor._prefetch_batches(iter(odd), 2, fuse=3))
+    assert [it[0] for it in items] == ["one", "one", "one"]
+
+
+def test_prefetch_unfused_contract_unchanged():
+    batches = _batches(4)
+    items = list(fluid.Executor._prefetch_batches(iter(batches), 2))
+    assert len(items) == 4 and isinstance(items[0], dict)
+
+
+# ----------------------------------------------------------- observability --
+
+def test_megastep_journal_and_debug_materializer(tmp_path, monkeypatch,
+                                                 capsys):
+    """Megastep events journal k/step0/amortized_ms; debug printing
+    materializes through materialize_fetches ONCE per boundary-crossing
+    chunk instead of syncing every step."""
+    monkeypatch.setenv("PADDLE_TPU_OBS", "1")
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL",
+                       str(tmp_path / "journal.jsonl"))
+    journal.clear()
+    calls = []
+    real = executor_mod.materialize_fetches
+
+    def spy(fetches):
+        calls.append(1)
+        return real(fetches)
+
+    monkeypatch.setattr(executor_mod, "materialize_fetches", spy)
+    main, startup, loss = _train_program(seed=13)
+    _epoch(main, startup, loss, _batches(8), fuse_steps=4, debug=True,
+           print_period=4, return_numpy=False)
+    megas = journal.recent(event="megastep")
+    assert len(megas) == 2
+    assert megas[0]["k"] == 4 and megas[0]["step0"] == 0
+    assert megas[1]["cache"] == "hit"
+    assert megas[0]["amortized_ms"] is not None
+    # 8 steps, period 4 -> boundaries at steps 0 and 4: exactly 2
+    # materializations (one per megastep containing a boundary)
+    assert len(calls) == 2
+    assert "batch 0:" in capsys.readouterr().out
+
+
+def test_obs_off_fused_guard_no_files_no_syncs(tmp_path, monkeypatch):
+    """Tier-1 guard: with every obs env unset, warm fused megasteps open NO
+    files, never read health flags, and return un-materialized device
+    arrays (zero fetch d2h syncs)."""
+    for var in ("PADDLE_TPU_OBS", "PADDLE_TPU_OBS_HEALTH",
+                "PADDLE_TPU_OBS_HEALTH_STATE", "PADDLE_TPU_FAULTS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("PADDLE_TPU_OBS_JOURNAL",
+                       str(tmp_path / "guard.jsonl"))
+    monkeypatch.chdir(tmp_path)
+    reads = []
+    monkeypatch.setattr(health, "read_flags",
+                        lambda flags: reads.append(1) or np.asarray(flags))
+    main, startup, loss = _train_program(seed=15)
+    feeds = _batches(4)
+    exe = fluid.Executor()
+    opened = []
+    real_open = builtins.open
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run_fused(main, feeds=feeds, fetch_list=[loss])  # compile
+        def spy_open(file, *a, **k):
+            opened.append(str(file))
+            return real_open(file, *a, **k)
+        monkeypatch.setattr(builtins, "open", spy_open)
+        try:
+            for _ in range(3):
+                vals = exe.run_fused(main, feeds=feeds, fetch_list=[loss])
+        finally:
+            monkeypatch.setattr(builtins, "open", real_open)
+        assert not isinstance(vals[0], np.ndarray)
+    watched = [p for p in opened
+               if "journal" in p or "trace" in p or p.endswith(".jsonl")
+               or "paddle_tpu" in p]
+    assert watched == [], f"fused hot path opened files: {watched}"
+    assert reads == [], "health flags must not be read with the mode off"
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_fused_health_one_packed_read_with_substep(monkeypatch):
+    """Armed watchdog under fusion: exactly ONE packed flag read per
+    megastep, and a nonfinite substep is attributed by var AND step."""
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "warn")
+    journal.clear()
+    reads = []
+    real = health.read_flags
+    monkeypatch.setattr(health, "read_flags",
+                        lambda flags: (reads.append(1), real(flags))[1])
+    main, startup, loss = _train_program(seed=17)
+    feeds = _batches(8)
+    feeds[5] = dict(feeds[5])
+    feeds[5]["x"] = feeds[5]["x"].copy()
+    feeds[5]["x"][0, 0] = np.inf  # loss goes nonfinite at step 5
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="substep 5"):
+            for i in range(0, 8, 4):
+                exe.run_fused(main, feeds=feeds[i:i + 4],
+                              fetch_list=[loss])
+    assert len(reads) == 2  # one packed read per megastep, no more
+    ev = journal.recent(event="tensor_nonfinite")
+    assert ev and ev[0]["substep"] == 5 and ev[0]["k"] == 4
+    assert ev[0]["var"] == loss.name
+
+
+# -------------------------------------------------------------- resilience --
+
+def test_fused_chaos_guardian_rollback_to_megastep_boundary(monkeypatch):
+    """Chaos under fusion: an injected nan inside megastep [4, 8) plus a
+    transient dispatch exc; StepGuardian(rollback) rewinds state AND rng
+    counter to the megastep boundary and the epoch completes finite."""
+    monkeypatch.delenv("PADDLE_TPU_OBS_HEALTH", raising=False)
+    journal.clear()
+    faults.clear()
+    try:
+        main, startup, loss = _train_program(seed=19)
+        main._rng_run_counter = 0
+        startup._rng_run_counter = 0
+        batches = _batches(12)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            # armed AFTER startup (its own dispatch is also step_idx 0):
+            # nan hits substep 5 (inside megastep [4, 8)); the transient
+            # exc hits the first megastep's dispatch and is retried
+            faults.install("nan:step=5;exc@dispatch:step=0")
+            g = StepGuardian(exe, main, nonfinite_policy="rollback",
+                             snapshot_interval=1)
+            last = g.train_from_dataset(dataset=_ListDataset(batches),
+                                        fetch_list=[loss], fuse_steps=4)
+            w = np.asarray(fluid.global_scope().find_var("fc_0.w_0"))
+        assert np.isfinite(w).all()
+        assert np.isfinite(np.asarray(last)).all()
+        rb = journal.recent(event="rollback")
+        assert rb, "nan fault must trigger a rollback"
+        # rollback lands on a megastep boundary (snapshot taken at step 4)
+        assert rb[0]["step"] == 4 and rb[0]["to_step"] == 4
+        rt = journal.recent(event="retry")
+        assert rt and rt[0]["site"] == "dispatch"
+    finally:
+        faults.clear()
+
+
+def test_guardian_fused_clean_run_byte_identical():
+    """No faults armed: a guarded fused epoch == the bare executor's fused
+    epoch, exact bytes (the guardian adds recovery, never arithmetic)."""
+    main, startup, loss = _train_program(seed=21)
+    batches = _batches(8)
+    _, w_bare = _epoch(main, startup, loss, batches, fuse_steps=4)
+    main._rng_run_counter = 0
+    startup._rng_run_counter = 0
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        g = StepGuardian(exe, main)
+        g.train_from_dataset(dataset=_ListDataset(batches),
+                             fetch_list=[loss], fuse_steps=4)
+        w_guarded = np.asarray(fluid.global_scope().find_var("fc_0.w_0"))
+    assert w_bare.tobytes() == w_guarded.tobytes()
+
+
+# ---------------------------------------------------------------- autotune --
+
+def test_fuse_steps_autotune_search_persists_and_reuses(tmp_path,
+                                                        monkeypatch):
+    """fuse_steps=0 under PADDLE_TPU_TUNE=search: the in-loop search
+    measures candidate K values on the live workload, persists the winner
+    (journaled autotune event), and the next epoch consults the cache
+    without re-searching."""
+    from paddle_tpu import tuning
+    from paddle_tpu.tuning import cache as tcache
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "search")
+    tcache.reset_for_tests(str(tmp_path / "autotune.json"))
+    journal.clear()
+    main, startup, loss = _train_program(seed=23)
+    batches = _batches(64)
+    l0, w_search = _epoch(main, startup, loss, batches, fuse_steps=0)
+    at = [e for e in journal.recent(event="autotune")
+          if e["choice"] == "fuse_steps.k"]
+    assert at and at[-1]["measured"]
+    exe = fluid.Executor()
+    params = exe._fuse_params(batches[0], [loss.name])
+    rec = tcache.CACHE.get(tuning.get_choice("fuse_steps.k").key(params))
+    assert rec is not None and rec["measured"]
+    winner = int(rec["winner"])
+    assert winner in tuning.get_choice("fuse_steps.k").K_CANDIDATES
+    # second epoch: cached decision, no new search journaled
+    journal.clear()
+    _epoch(main, startup, loss, batches, fuse_steps=0)
+    assert not [e for e in journal.recent(event="autotune")
+                if e["choice"] == "fuse_steps.k"]
+    # every batch trained in both epochs regardless of the search schedule
+    assert main._rng_run_counter == 64
+    tcache.reset_for_tests()
+
+
+def test_fuse_steps_search_trains_identically(tmp_path, monkeypatch):
+    """The search epoch's megasteps ARE training steps: state after a
+    fuse_steps=0 search epoch is byte-identical to the plain unfused
+    epoch (same batches, same rng schedule)."""
+    from paddle_tpu.tuning import cache as tcache
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "search")
+    tcache.reset_for_tests(str(tmp_path / "autotune.json"))
+    main, startup, loss = _train_program(seed=25)
+    batches = _batches(40)
+    _, w_plain = _epoch(main, startup, loss, batches, fuse_steps=1)
+    _, w_search = _epoch(main, startup, loss, batches, fuse_steps=0)
+    assert w_plain.tobytes() == w_search.tobytes()
+    tcache.reset_for_tests()
+
+
+def test_fuse_ineligible_warns_and_runs_unfused(monkeypatch):
+    """A dist-strategy CompiledProgram cannot fuse: train_from_dataset
+    warns once and completes unfused rather than failing the epoch."""
+    main, startup, loss = _train_program(seed=27)
+    cp = fluid.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="running unfused"):
+            exe.train_from_dataset(cp, _ListDataset(_batches(4, bs=8)),
+                                   fetch_list=[loss], fuse_steps=4)
+
+
+# ---------------------------------------------------------------- analysis --
+
+def test_pt034_fused_recompile_lint():
+    """PT03x under fused intent: a dynamic batch dim earns PT034 only when
+    verify() is told the program runs fused (fuse_k > 1)."""
+    from paddle_tpu import analysis
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", [8], "float32")  # dynamic leading batch dim
+        fluid.layers.scale(x, scale=2.0)
+    plain = {d.code for d in analysis.verify(main)}
+    fused = {d.code for d in analysis.verify(main, fuse_k=4)}
+    assert "PT034" not in plain and "PT031" in plain
+    assert "PT034" in fused and "PT031" in fused
+
+
+def test_fused_verify_gate_uses_per_step_shapes(monkeypatch):
+    """The executor's verify gate sees the PER-STEP feed shapes (leading K
+    stripped), so fused compiles produce the same static verdict as
+    unfused ones -- plus the PT034 fused-churn note."""
+    from paddle_tpu import analysis
+    seen = {}
+    real = analysis.verify
+
+    def spy(program, **kw):
+        seen.update(kw)
+        return real(program, **kw)
+
+    monkeypatch.setattr(analysis, "verify", spy)
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "warn")
+    main, startup, loss = _train_program(seed=29)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run_fused(main, feeds=_batches(4), fetch_list=[loss])
+    assert seen.get("fuse_k") == 4
+
+
+# -------------------------------------------------------------- obs_report --
+
+def test_obs_report_megastep_section():
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.obs_report", "--selftest"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -178,6 +178,56 @@ def render_resilience(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+# --------------------------------------------------------------- megastep --
+
+def _counter_total(snapshot: Optional[dict], name: str) -> Optional[float]:
+    """Sum a counter family's samples from a metrics snapshot (None when
+    the family is absent or no snapshot was given)."""
+    if not snapshot:
+        return None
+    total, seen = 0.0, False
+    for f in snapshot.get("families", []):
+        if f.get("name") == name:
+            for s in f.get("samples", []):
+                total += s.get("value", 0.0)
+                seen = True
+    return total if seen else None
+
+
+def render_megastep(events: List[dict],
+                    snapshot: Optional[dict] = None) -> str:
+    """Fused multi-step execution activity: ``megastep`` journal events
+    (Executor.run_fused) + the lazy-fetch materialization counter."""
+    lines = ["== Megastep =="]
+    megas = [e for e in events if e.get("event") == "megastep"]
+    mats = _counter_total(snapshot, "fused_fetch_materializations_total")
+    if not megas and not mats:
+        lines.append("unfused: no megastep events (run "
+                     "train_from_dataset(fuse_steps=K) or bench "
+                     "--fuse-steps)")
+        return "\n".join(lines)
+    substeps = sum(int(e.get("k") or 0) for e in megas)
+    ks = sorted({int(e.get("k") or 0) for e in megas})
+    lines.append(f"{len(megas)} megasteps covering {substeps} substeps "
+                 f"(K values: {ks})")
+    amort = [e["amortized_ms"] for e in megas
+             if e.get("amortized_ms") is not None]
+    if amort:
+        lines.append("amortized dispatch ms/substep: " + _stats(amort))
+    compiles = [e["compile_ms"] for e in megas
+                if e.get("compile_ms") is not None]
+    if compiles:
+        lines.append("megastep compile_ms: " + _stats(compiles))
+    hits = sum(1 for e in megas if e.get("cache") == "hit")
+    if megas:
+        lines.append(f"compile cache: {hits} hits / {len(megas) - hits} "
+                     f"misses")
+    if mats is not None:
+        lines.append(f"fetch materializations (lazy-fetch d2h syncs): "
+                     f"{mats:g}")
+    return "\n".join(lines)
+
+
 # ----------------------------------------------------------------- memory --
 
 _MEMORY_FAMILIES = ("device_memory_bytes_in_use", "device_memory_peak_bytes",
@@ -332,6 +382,7 @@ def render_report(events: Optional[List[dict]],
     parts = ["# paddle_tpu observability report"]
     if events is not None:
         parts.append(render_journal(events))
+        parts.append(render_megastep(events, snapshot))
         parts.append(render_health(events))
         parts.append(render_resilience(events))
     if trace_events is not None:
@@ -373,6 +424,7 @@ def selftest() -> int:
     reg.gauge("program_temp_bytes", program="1:v0").set(3e8)
     reg.gauge("program_static_peak_bytes", program="1:v0").set(1.8e9)
     reg.gauge("program_static_peak_ratio", program="1:v0").set(1.2)
+    reg.counter("fused_fetch_materializations_total").inc(3)
     reg.counter("tensor_nonfinite_total", where="executor").inc()
     reg.counter("anomaly_total", kind="step_time").inc()
     reg.counter("fault_injected_total", kind="nan", site="fetch").inc()
@@ -390,6 +442,15 @@ def selftest() -> int:
          "feed": {"x": [[8, 3], "float32"]}, "fetch": ["loss"], "ts": 1.0},
         {"event": "recompile", "program": 1, "version": 0,
          "changed": ["shape"], "ts": 2.0},
+        # megastep section (fused multi-step execution)
+        {"event": "megastep", "program": 1, "version": 0, "cache": "miss",
+         "k": 8, "step0": 0, "compile_ms": 950.0, "run_ms": 24.0,
+         "amortized_ms": 3.0, "feed": {"x": [[8, 3], "float32"]},
+         "fetch": ["loss"], "ts": 2.2},
+        {"event": "megastep", "program": 1, "version": 0, "cache": "hit",
+         "k": 8, "step0": 8, "compile_ms": None, "run_ms": 20.0,
+         "amortized_ms": 2.5, "feed": {"x": [[8, 3], "float32"]},
+         "fetch": ["loss"], "ts": 2.4},
         {"event": "tensor_nonfinite", "program": "1:v0",
          "where": "executor", "var": "loss", "vars": ["loss"], "ts": 3.0},
         {"event": "step_time_anomaly", "program": "1:v0", "step_ms": 99.0,
@@ -454,6 +515,10 @@ def selftest() -> int:
         for must in ("2 executor runs", "1 recompiles", "hit rate",
                      "changed ['shape']", "program_mfu", "0.42",
                      "executor_run_seconds", "n=4",
+                     # megastep section
+                     "2 megasteps covering 16 substeps",
+                     "amortized dispatch ms/substep",
+                     "fetch materializations (lazy-fetch d2h syncs): 3",
                      # health section
                      "NONFINITE executor", "'loss'", "step-time anomalies",
                      "99.0ms",
@@ -478,6 +543,7 @@ def selftest() -> int:
         # empty journal/trace render degrades, never raises
         assert "healthy" in render_health([])
         assert "quiet" in render_resilience([])
+        assert "unfused" in render_megastep([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})
     print("obs_report selftest: OK")
