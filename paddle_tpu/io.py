@@ -19,6 +19,7 @@ SURVEY.md §5.4). bfloat16 is stored as uint16 with a sidecar dtype tag.
 from __future__ import annotations
 
 import json
+import zlib
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -29,6 +30,31 @@ from .core.executor import global_scope
 from .core.executor import Executor, Scope  # noqa: F401
 from .utils import fs as _fsio
 from .framework import Parameter, Program, Variable, default_main_program
+
+
+#: manifest format. v2 adds per-chunk ``bytes`` + ``crc32`` (recorded over
+#: the serialized .npy bytes at save time) and the head-level
+#: ``format_version``; v1 (absent) checkpoints still restore, with
+#: integrity checks skipped.
+FORMAT_VERSION = 2
+
+
+class _CrcWriter:
+    """File-object wrapper that accumulates crc32 + byte count as np.save
+    streams through it -- the manifest records integrity over exactly what
+    lands on disk, without buffering a second full copy of the chunk in
+    host memory."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.nbytes = 0
+
+    def write(self, data):
+        b = bytes(data)
+        self.crc = zlib.crc32(b, self.crc)
+        self.nbytes += len(b)
+        return self._f.write(b)
 
 
 def _storage_view(arr):
@@ -77,9 +103,13 @@ def _is_sharded_array(val):
                 for s in val.addressable_shards}) > 1
 
 
-def _save_var(dirname, name, val, rank):
-    """Write var chunks owned by this process; return a manifest entry (or None
-    when this process owns nothing -- e.g. a replicated shard held elsewhere)."""
+def _snapshot_var(name, val, rank):
+    """Phase 1 of a save: d2h host copies of the chunks this process owns.
+    Returns a snapshot entry (manifest entry + in-memory ``data`` per
+    chunk), or None when this process owns nothing -- e.g. a replicated
+    shard held elsewhere.  This is the only part of a save that must
+    happen at the step boundary; writing the snapshot is pure host work
+    (``Checkpointer`` async saves run it on a background thread)."""
     base = name.replace("/", "__")
     if _is_sharded_array(val):
         shape = tuple(val.shape)
@@ -95,9 +125,8 @@ def _save_var(dirname, name, val, rank):
                 continue
             seen.add(key)
             arr, dtype = _storage_view(np.asarray(sh.data))
-            fname = f"{base}.r{rank}c{i}.npy"
-            _fsio.save_array(_fsio.join(dirname, fname), arr)
-            chunks.append({"file": fname, "index": region})
+            chunks.append({"file": f"{base}.r{rank}c{i}.npy",
+                           "index": region, "data": arr})
         if not chunks:
             return None
         if dtype is None:
@@ -109,11 +138,37 @@ def _save_var(dirname, name, val, rank):
     if rank != 0:
         return None
     arr, dtype = _storage_view(np.asarray(val))
-    fname = base + ".npy"
-    _fsio.save_array(_fsio.join(dirname, fname), arr)
     return {"name": name, "dtype": dtype, "shape": list(arr.shape),
-            "chunks": [{"file": fname,
+            "chunks": [{"file": base + ".npy", "data": arr,
                         "index": [[0, s] for s in arr.shape]}]}
+
+
+def _write_snap(dirname, snap):
+    """Phase 2 of a save: write one snapshot entry's chunk files, recording
+    byte size + crc32 of the serialized bytes in the manifest entry.
+    Returns (manifest_entry, bytes_written)."""
+    chunks = []
+    nbytes = 0
+    for ch in snap["chunks"]:
+        with _fsio.open_file(_fsio.join(dirname, ch["file"]), "wb") as f:
+            w = _CrcWriter(f)
+            np.save(w, np.ascontiguousarray(ch["data"]),
+                    allow_pickle=False)
+        chunks.append({"file": ch["file"], "index": ch["index"],
+                       "bytes": w.nbytes, "crc32": w.crc})
+        nbytes += w.nbytes
+    entry = {k: v for k, v in snap.items() if k != "chunks"}
+    entry["chunks"] = chunks
+    return entry, nbytes
+
+
+def _save_var(dirname, name, val, rank):
+    """Write var chunks owned by this process; return (manifest entry,
+    bytes written) or None when this process owns nothing."""
+    snap = _snapshot_var(name, val, rank)
+    if snap is None:
+        return None
+    return _write_snap(dirname, snap)
 
 
 def _stitch(dirname, meta, region):
@@ -177,7 +232,8 @@ def _manifest_path(dirname, filename, rank):
     return _fsio.join(dirname, base if rank == 0 else f"{base}.rank{rank}")
 
 
-def _read_manifests(dirname, filename):
+def _read_manifest_docs(dirname, filename):
+    """All rank manifests of one checkpoint: (head, [(rank, doc), ...])."""
     base = _fsio.join(dirname, filename or "__manifest__.json")
     if not _fsio.exists(base):
         raise FileNotFoundError(f"no checkpoint manifest at {base}")
@@ -187,7 +243,7 @@ def _read_manifests(dirname, filename):
     # checkpoint -- a stale .rankN from an earlier wider save in the same dir
     # must not be merged (it would silently mix old chunk data into the load)
     nranks = head.get("nranks", 1)
-    metas = {}
+    docs = []
     for r in range(nranks):
         p = base if r == 0 else f"{base}.rank{r}"
         if not _fsio.exists(p):
@@ -196,12 +252,104 @@ def _read_manifests(dirname, filename):
                 f"rank {r}'s manifest {p} is missing")
         with _fsio.open_file(p) as f:
             doc = head if r == 0 else json.load(f)
+        docs.append((r, doc))
+    return head, docs
+
+
+def _read_manifests(dirname, filename):
+    _, docs = _read_manifest_docs(dirname, filename)
+    metas = {}
+    for _, doc in docs:
         for m in doc["vars"]:
             if m["name"] in metas:
                 metas[m["name"]]["chunks"].extend(m["chunks"])
             else:
                 metas[m["name"]] = dict(m)
     return metas
+
+
+def verify_checkpoint(dirname, filename=None, level: str = "crc") -> dict:
+    """Integrity report for one checkpoint directory.
+
+    ``level="size"`` is the cheap completeness scan (one stat per chunk:
+    exists + recorded byte size); ``level="crc"`` additionally reads every
+    chunk and checks its recorded crc32.  Never raises: manifest problems
+    become ``manifest`` chunks in the report.  Per-chunk ``status`` is one
+    of ``ok`` / ``missing`` / ``size_mismatch`` / ``crc_mismatch`` /
+    ``unverified`` (a pre-v2 manifest with no recorded size/crc -- counted
+    as passing so old checkpoints keep restoring).  ``ok`` is the
+    tree-level verdict the Checkpointer's ``_is_complete`` trusts."""
+    if level not in ("size", "crc"):
+        raise ValueError(f"level must be 'size' or 'crc', got {level!r}")
+    report = {"dir": str(dirname), "level": level, "ok": True,
+              "format_version": None, "nranks": None, "chunks": []}
+
+    def bad(status, **kw):
+        report["ok"] = False
+        report["chunks"].append(dict(status=status, **kw))
+
+    try:
+        head, docs = _read_manifest_docs(dirname, filename)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        bad("manifest", rank=None, var=None, file=None,
+            detail=f"{type(e).__name__}: {e}")
+        return report
+    report["format_version"] = head.get("format_version", 1)
+    report["nranks"] = head.get("nranks", 1)
+    for rank, doc in docs:
+        try:
+            # materialize the full (var, chunk) list up front: a manifest
+            # that parses as JSON but has the wrong shape (a non-dict var,
+            # a chunk without "file") is a torn/corrupt save and must
+            # yield a "manifest" finding, never an exception -- the
+            # Checkpointer's completeness scan relies on this to fall
+            # through to the previous step
+            pairs = [(m, ch) for m in doc["vars"]
+                     for ch in (m.get("chunks") or [])]
+            recs = [({"rank": rank, "var": m.get("name"),
+                      "file": ch["file"]}, ch) for m, ch in pairs]
+        except (KeyError, TypeError, AttributeError) as e:
+            bad("manifest", rank=rank, var=None, file=None,
+                detail=f"{type(e).__name__}: {e}")
+            continue
+        for rec, ch in recs:
+            path = _fsio.join(dirname, ch["file"])
+            try:
+                if not _fsio.exists(path):
+                    bad("missing", detail="chunk file missing", **rec)
+                    continue
+                want = ch.get("bytes")
+                if want is None:
+                    report["chunks"].append(
+                        dict(status="unverified",
+                             detail="pre-v2 manifest: no recorded "
+                                    "size/crc", **rec))
+                    continue
+                if level == "size":
+                    got = _fsio.file_size(path)
+                    if got is not None and got != want:
+                        bad("size_mismatch",
+                            detail=f"{got} bytes, manifest says {want}",
+                            **rec)
+                        continue
+                else:
+                    data = _fsio.read_bytes(path)
+                    if len(data) != want:
+                        bad("size_mismatch",
+                            detail=f"{len(data)} bytes, manifest says "
+                                   f"{want}", **rec)
+                        continue
+                    crc = ch.get("crc32")
+                    if crc is not None and zlib.crc32(data) != crc:
+                        bad("crc_mismatch",
+                            detail=f"crc32 {zlib.crc32(data)}, manifest "
+                                   f"says {crc}", **rec)
+                        continue
+            except (OSError, TypeError, ValueError) as e:
+                bad("missing", detail=f"{type(e).__name__}: {e}", **rec)
+                continue
+            report["chunks"].append(dict(status="ok", **rec))
+    return report
 
 
 def save_vars(executor, dirname, main_program=None, vars: Optional[List] = None,
@@ -219,18 +367,22 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[List] = None,
     _fsio.makedirs(dirname, exist_ok=True)
     _barrier()   # every process must see the directory before writing
     manifest = []
+    nbytes = 0
     for v in vars:
         name = v.name if isinstance(v, Variable) else str(v)
         val = scope.find_var(name)
         if val is None:
             raise RuntimeError(f"variable {name!r} has no value in scope; "
                                f"run the startup program before saving")
-        entry = _save_var(dirname, name, val, rank)
-        if entry is not None:
-            manifest.append(entry)
+        saved = _save_var(dirname, name, val, rank)
+        if saved is not None:
+            manifest.append(saved[0])
+            nbytes += saved[1]
     with _fsio.open_file(_manifest_path(dirname, filename, rank), "w") as f:
-        json.dump({"vars": manifest, "nranks": jax.process_count()}, f)
+        json.dump({"vars": manifest, "nranks": jax.process_count(),
+                   "format_version": FORMAT_VERSION}, f)
     _barrier()   # checkpoint is complete only when every rank has written
+    return nbytes
 
 
 def _is_param(v):
@@ -243,15 +395,15 @@ def _is_persistable(v):
 
 def save_params(executor, dirname, main_program=None, filename=None):
     """Parameters only (no optimizer state) -- reference io.py:259."""
-    save_vars(executor, dirname, main_program, predicate=_is_param,
-              filename=filename)
+    return save_vars(executor, dirname, main_program, predicate=_is_param,
+                     filename=filename)
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):
     """Everything needed to resume training (params + optimizer moments + bn
     stats + LR counters) -- reference io.py:509."""
-    save_vars(executor, dirname, main_program, predicate=_is_persistable,
-              filename=filename)
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,

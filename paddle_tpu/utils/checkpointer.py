@@ -96,22 +96,17 @@ class Checkpointer:
 
     def _is_complete(self, d: str) -> bool:
         """True when ``d`` holds a finished save: every rank manifest the
-        save promised parses (``io._read_manifests`` -- io.py owns the
-        manifest format, so its reader is reused rather than re-implementing
-        the layout) and every chunk file they list is present.
-        ``utils/fs.py`` replace() is copy-then-delete on remote stores, so a
-        crashed save can leave any of these partially visible -- a resume
-        point must be validated, not assumed."""
+        save promised parses and every chunk file they list is present AT
+        ITS RECORDED BYTE SIZE (``io.verify_checkpoint(level="size")`` --
+        io.py owns the manifest format, so its verifier is reused rather
+        than re-implementing the layout).  A zero-byte or truncated chunk
+        -- the torn-write signature of ``fs.replace``'s copy-then-delete
+        window on remote stores -- must NOT count as a resume point;
+        existence alone proved nothing.  Pre-v2 manifests (no recorded
+        sizes) fall back to the existence check so old checkpoints keep
+        restoring."""
         from .. import io as _io
-        try:
-            metas = _io._read_manifests(d, None)
-            for m in metas.values():
-                for ch in m.get("chunks") or []:
-                    if not _fsio.exists(_fsio.join(d, ch["file"])):
-                        return False
-        except (OSError, ValueError, KeyError, TypeError):
-            return False
-        return True
+        return _io.verify_checkpoint(d, level="size")["ok"]
 
     def _complete_steps(self):
         """Yield the steps of complete ``ckpt-*`` dirs, newest first.

@@ -88,6 +88,26 @@ def replace(src, dst):
     os.replace(src, dst)
 
 
+def file_size(path):
+    """Size in bytes, or None when the store does not report one (some
+    fsspec backends omit ``size`` from info()) -- callers must treat None
+    as "unknown", never as 0."""
+    if is_remote(path):
+        size = _fs(path).info(str(path)).get("size")
+        return None if size is None else int(size)
+    return os.path.getsize(path)
+
+
+def read_bytes(path) -> bytes:
+    with open_file(path, "rb") as f:
+        return f.read()
+
+
+def write_bytes(path, data: bytes):
+    with open_file(path, "wb") as f:
+        f.write(data)
+
+
 def save_array(path, arr):
     """np.save through the hook (np.save writes to file objects)."""
     import numpy as np
