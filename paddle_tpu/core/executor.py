@@ -1399,7 +1399,8 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fuse_steps: int = 1, return_numpy: bool = True):
+                           fuse_steps: int = 1, return_numpy: bool = True,
+                           skip_batches: int = 0):
         """Run one epoch over a Dataset (reference executor.py:920
         train_from_dataset, which spun up C++ device-worker threads; here
         the dataset generator feeds the jitted step loop through a
@@ -1419,7 +1420,14 @@ class Executor:
         only at debug ``print_period`` boundaries and -- when
         ``return_numpy`` (default) -- on return; ``return_numpy=False``
         returns the last step's fetches as live device arrays (not
-        donated)."""
+        donated).
+
+        ``skip_batches=N`` fast-forwards past the first N batches of the
+        epoch without running them -- the exact-resume half of
+        ``Checkpointer``'s ``trainstate.json`` (a restored run continues
+        on the exact next batch; megastep grouping stays aligned when N
+        is a multiple of K, which checkpoint-at-boundary saves
+        guarantee)."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset (use "
                              "fluid.DatasetFactory().create_dataset(...))")
@@ -1443,6 +1451,9 @@ class Executor:
                 k = 1
         depth = self._prefetch_depth(thread, dataset)
         batches = dataset._iter_batches()
+        if skip_batches:
+            import itertools
+            batches = itertools.islice(batches, int(skip_batches), None)
         search_params = None
         if k == 0:
             k, batches, search_params = self._resolve_fuse_steps(

@@ -112,10 +112,23 @@ class Predictor:
         from .observability import timeline as _timeline
         from .observability.metrics import REGISTRY as _OBS
         if not isinstance(inputs, dict):
+            inputs = list(inputs)
+            if len(inputs) != len(self.feed_names):
+                raise ValueError(
+                    f"Predictor.run got {len(inputs)} positional inputs "
+                    f"but the model feeds {len(self.feed_names)}: "
+                    f"{self.feed_names}")
             inputs = dict(zip(self.feed_names, inputs))
         missing = [n for n in self.feed_names if n not in inputs]
         if missing:
             raise ValueError(f"Predictor.run missing inputs {missing}")
+        unexpected = sorted(k for k in inputs if k not in self.feed_names)
+        if unexpected:
+            # a typo'd feed key must not silently serve stale/zero values
+            # for the var the caller thought they were feeding
+            raise ValueError(
+                f"Predictor.run got unexpected inputs {unexpected}; the "
+                f"model feeds are {self.feed_names}")
         t0 = time.perf_counter()
         n_compiled = len(self._compiled)
         exe = self._executable(inputs)

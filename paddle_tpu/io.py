@@ -18,7 +18,9 @@ SURVEY.md §5.4). bfloat16 is stored as uint16 with a sidecar dtype tag.
 """
 from __future__ import annotations
 
+import io as _pyio
 import json
+import warnings
 import zlib
 from typing import List, Optional, Sequence
 
@@ -37,6 +39,18 @@ from .framework import Parameter, Program, Variable, default_main_program
 #: ``format_version``; v1 (absent) checkpoints still restore, with
 #: integrity checks skipped.
 FORMAT_VERSION = 2
+
+
+class CheckpointCorruption(RuntimeError):
+    """A chunk file failed its recorded size/crc32 check -- the checkpoint
+    must not be restored (``Checkpointer.restore`` quarantines it and falls
+    through to the previous complete step).  ``kind`` is the detection
+    class (``size`` / ``crc``)."""
+
+    def __init__(self, msg: str, kind: str = "crc", path: str = ""):
+        super().__init__(msg)
+        self.kind = kind
+        self.path = path
 
 
 class _CrcWriter:
@@ -171,8 +185,54 @@ def _save_var(dirname, name, val, rank):
     return _write_snap(dirname, snap)
 
 
-def _stitch(dirname, meta, region):
-    """Assemble the [start, stop) region of a var from its chunk files."""
+def _verify_on_load() -> bool:
+    """Checksum-verify chunk reads?  On by default (restores are rare and a
+    bit-flipped weight restored silently is worse than a crash);
+    ``PADDLE_TPU_CKPT_VERIFY=0`` opts out (e.g. to mmap huge local chunks
+    during reshard-on-load)."""
+    from .observability.journal import mode_env
+    return mode_env("PADDLE_TPU_CKPT_VERIFY", modes=("off", "on"),
+                    default="on", truthy="on") == "on"
+
+
+def _load_chunk(dirname, ch, varname):
+    """One chunk file -> array, verified against the manifest's recorded
+    size/crc32 when present (v2 manifests).  A mismatch raises
+    :class:`CheckpointCorruption` (counted in
+    ``checkpoint_corruption_total{kind}``); pre-v2 chunks load unverified
+    through the mmap-capable fast path."""
+    path = _fsio.join(dirname, ch["file"])
+    want, crc = ch.get("bytes"), ch.get("crc32")
+    if (want is None and crc is None) or not _verify_on_load():
+        return _fsio.load_array(path)
+    data = _fsio.read_bytes(path)
+    kind = None
+    if want is not None and len(data) != want:
+        kind, detail = "size", f"{len(data)} bytes, manifest says {want}"
+    elif crc is not None and zlib.crc32(data) != crc:
+        kind, detail = "crc", f"crc32 {zlib.crc32(data)}, manifest says {crc}"
+    if kind is not None:
+        from .observability import journal as _journal
+        from .observability.metrics import REGISTRY as _OBS
+        _OBS.counter("checkpoint_corruption_total",
+                     "corrupt checkpoint chunks detected, by kind",
+                     kind=kind).inc()
+        _journal.emit({"event": "ckpt_corrupt", "kind": kind,
+                       "file": str(path), "var": varname,
+                       "detail": detail})
+        raise CheckpointCorruption(
+            f"checkpoint chunk {path} for var {varname!r} is corrupt "
+            f"({detail}); refusing to restore it", kind=kind,
+            path=str(path))
+    return np.load(_pyio.BytesIO(data), allow_pickle=False)
+
+
+def _stitch(dirname, meta, region, cache=None):
+    """Assemble the [start, stop) region of a var from its chunk files.
+    ``cache`` (file -> loaded array) is shared across the regions of one
+    ``_load_var`` call: reshard-on-load stitches one region per distinct
+    device index, and a chunk overlapping R regions must be read (and
+    crc-verified) once, not R times."""
     out = np.empty([b - a for a, b in region],
                    dtype=_storage_dtype(meta["dtype"]))
     covered = 0
@@ -182,7 +242,11 @@ def _stitch(dirname, meta, region):
                  for (a, b), (ca, cb) in zip(region, cidx)]
         if any(lo >= hi for lo, hi in inter):
             continue
-        src = _fsio.load_array(_fsio.join(dirname, ch["file"]))
+        src = cache.get(ch["file"]) if cache is not None else None
+        if src is None:
+            src = _load_chunk(dirname, ch, meta["name"])
+            if cache is not None:
+                cache[ch["file"]] = src
         src_sl = tuple(slice(lo - ca, hi - ca)
                        for (lo, hi), (ca, _) in zip(inter, cidx))
         dst_sl = tuple(slice(lo - a, hi - a)
@@ -209,11 +273,12 @@ def _load_var(dirname, meta, sharding=None):
     idx_map = sharding.addressable_devices_indices_map(shape)
     pieces = {}
     bufs = []
+    chunk_cache: dict = {}
     for dev, idx in idx_map.items():
         region = _norm_index(idx, shape)
         key = tuple(map(tuple, region))
         if key not in pieces:
-            pieces[key] = _stitch(dirname, meta, region)
+            pieces[key] = _stitch(dirname, meta, region, chunk_cache)
         bufs.append(jax.device_put(pieces[key], dev))
     return jax.make_array_from_single_device_arrays(shape, sharding, bufs)
 
@@ -256,8 +321,24 @@ def _read_manifest_docs(dirname, filename):
     return head, docs
 
 
+_warned_v1 = False
+
+
 def _read_manifests(dirname, filename):
-    _, docs = _read_manifest_docs(dirname, filename)
+    head, docs = _read_manifest_docs(dirname, filename)
+    if head.get("format_version") is None:
+        # pre-v2 checkpoint: no recorded sizes/checksums, so integrity
+        # checks are skipped on this load. Warn ONCE per process -- old
+        # checkpoints must keep restoring, but silently trusting them
+        # forever would hide the downgrade.
+        global _warned_v1
+        if not _warned_v1:
+            _warned_v1 = True
+            warnings.warn(
+                f"checkpoint at {dirname} has a pre-v2 manifest (no "
+                f"recorded chunk sizes/crc32); integrity checks are "
+                f"skipped for old-format checkpoints. Re-save to upgrade.",
+                UserWarning, stacklevel=3)
     metas = {}
     for _, doc in docs:
         for m in doc["vars"]:
@@ -404,6 +485,51 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
     stats + LR counters) -- reference io.py:509."""
     return save_vars(executor, dirname, main_program,
                      predicate=_is_persistable, filename=filename)
+
+
+def snapshot_persistables(main_program=None, scope=None):
+    """Phase 1 of an async checkpoint save: d2h host snapshot of every
+    persistable var's chunks owned by this process.  This is the only part
+    of a save that must block the training loop (the device buffers may be
+    donated by the next step); writing is pure host work --
+    :func:`write_snapshot` runs it on ``Checkpointer``'s background writer
+    thread.  Returns an opaque snapshot dict."""
+    import jax
+    main_program, _ = _unwrap_program(main_program)
+    scope = scope or global_scope()
+    rank = jax.process_index()
+    entries = []
+    for v in main_program.list_vars():
+        if not _is_persistable(v):
+            continue
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(f"variable {v.name!r} has no value in scope; "
+                               f"run the startup program before saving")
+        snap = _snapshot_var(v.name, val, rank)
+        if snap is not None:
+            entries.append(snap)
+    return {"rank": rank, "nranks": jax.process_count(), "entries": entries}
+
+
+def write_snapshot(snapshot, dirname, filename=None) -> int:
+    """Phase 2 of an async checkpoint save: write a
+    :func:`snapshot_persistables` snapshot's chunk files + this rank's
+    manifest into ``dirname``.  No barriers (the caller owns multi-host
+    coordination; ``Checkpointer`` only runs async saves single-host).
+    Returns total chunk bytes written."""
+    _fsio.makedirs(dirname, exist_ok=True)
+    manifest = []
+    nbytes = 0
+    for snap in snapshot["entries"]:
+        entry, n = _write_snap(dirname, snap)
+        manifest.append(entry)
+        nbytes += n
+    with _fsio.open_file(_manifest_path(dirname, filename,
+                                        snapshot["rank"]), "w") as f:
+        json.dump({"vars": manifest, "nranks": snapshot["nranks"],
+                   "format_version": FORMAT_VERSION}, f)
+    return nbytes
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,

@@ -18,15 +18,19 @@ Spec grammar (entries separated by ``;``)::
     exc@checkpoint_write:times=2   # first two checkpoint writes fail
     hang@fetch:step=4:seconds=30   # artificial hang (trips the step deadline)
     preempt:step=7                 # simulated SIGTERM (preemption flag)
+    corrupt:step=5:seed=1          # bit-flip a written checkpoint chunk
+    truncate:step=5                # cut a written checkpoint chunk in half
 
 Kinds: ``nan`` (also ``value=inf|-inf|<float>``), ``exc``, ``hang``,
-``preempt``.  Sites: ``compile``, ``dispatch``, ``fetch``,
-``checkpoint_write`` (``nan`` ignores the site -- it corrupts the step's
-outputs/state by tensor name).  Keys: ``step`` (program step index, omit =
-every step), ``var``, ``times`` (total fires, default 1 so a rolled-back
-step does not re-trip the same fault forever; 0 = unlimited), ``seconds``
-(hang duration), ``prob`` + ``seed`` (seeded Bernoulli draw per match --
-deterministic chaos), ``value``.
+``preempt``, ``corrupt``, ``truncate``.  Sites: ``compile``, ``dispatch``,
+``fetch``, ``checkpoint_write`` (``nan`` ignores the site -- it corrupts
+the step's outputs/state by tensor name; ``corrupt``/``truncate`` only
+make sense at ``checkpoint_write``, where they damage the files the save
+just wrote -- see :func:`mutate_checkpoint`).  Keys: ``step`` (program
+step index, omit = every step), ``var``, ``times`` (total fires, default 1
+so a rolled-back step does not re-trip the same fault forever; 0 =
+unlimited), ``seconds`` (hang duration), ``prob`` + ``seed`` (seeded
+Bernoulli draw per match -- deterministic chaos), ``value``.
 
 Every fire increments ``fault_injected_total{kind,site}`` and journals a
 ``fault`` event through the observability registry.  With nothing armed the
@@ -47,10 +51,15 @@ from ..observability.metrics import REGISTRY as _OBS
 
 ENV_VAR = "PADDLE_TPU_FAULTS"
 
-KINDS = ("nan", "exc", "hang", "preempt")
+KINDS = ("nan", "exc", "hang", "preempt", "corrupt", "truncate")
 SITES = ("compile", "dispatch", "fetch", "checkpoint_write")
 _DEFAULT_SITE = {"nan": "fetch", "exc": "dispatch", "hang": "fetch",
-                 "preempt": "dispatch"}
+                 "preempt": "dispatch", "corrupt": "checkpoint_write",
+                 "truncate": "checkpoint_write"}
+#: kinds that are NOT raised/slept at a fire() hook point: ``nan`` corrupts
+#: step outputs (corrupt_step), ``corrupt``/``truncate`` damage the files a
+#: checkpoint save just wrote (mutate_checkpoint)
+_DATA_KINDS = ("nan", "corrupt", "truncate")
 
 
 class FaultSpecError(ValueError):
@@ -237,9 +246,10 @@ def _record(f: Fault, site: str, step, program=None, var=None):
 def fire(site: str, step: Optional[int] = None, program=None):
     """Hook point: fire any armed exc/hang/preempt fault matching
     ``site``/``step``. Called by Executor.run and Checkpointer.save only
-    when ``_active`` is non-empty."""
+    when ``_active`` is non-empty.  Data kinds (nan/corrupt/truncate) have
+    their own hook points (corrupt_step / mutate_checkpoint)."""
     for f in _active:
-        if f.kind == "nan" or not f.matches(site, step):
+        if f.kind in _DATA_KINDS or not f.matches(site, step):
             continue
         _record(f, site, step, program=program)
         if f.kind == "preempt":
@@ -312,6 +322,66 @@ def corrupt_step(step, fetch_names: Sequence[str], fetches, new_state: dict,
                     "detail": "var matched no fetch or written float "
                               "state var; fault not consumed"})
     return fetches, new_state
+
+
+def mutate_checkpoint(dirname, step: Optional[int] = None) -> List[dict]:
+    """Hook point: apply armed ``corrupt``/``truncate`` faults to the
+    checkpoint files a save just wrote under ``dirname`` (the chaos half
+    of the durable-checkpoint contract: the restore path must *detect*
+    the damage, quarantine the step, and fall through).
+
+    ``corrupt`` flips one bit of a seeded-random chunk file (size
+    unchanged -- only the crc32 restore check can catch it); ``truncate``
+    cuts a seeded-random chunk to half its bytes (the completeness scan's
+    size check catches it).  ``var`` narrows the victim to chunks of that
+    var.  Target selection draws from the fault's own seeded rng, so a
+    given (seed, match sequence) always damages the same file at the same
+    offset.  Returns the applied mutations (chaos CLI reporting)."""
+    if not _active:
+        return []
+    from ..utils import fs as _fsio
+    applied = []
+    for f in _active:
+        if f.kind not in ("corrupt", "truncate") or \
+                not f.matches("checkpoint_write", step):
+            continue
+        try:
+            names = sorted(n for n in _fsio.listdir(dirname)
+                           if n.endswith(".npy"))
+        except OSError:
+            names = []
+        if f.var is not None:
+            base = f.var.replace("/", "__")
+            names = [n for n in names if n.startswith(base + ".")
+                     or n == base + ".npy"]
+        if not names:
+            f.missed += 1
+            if f.missed == 1:
+                _journal.emit({"event": "fault_miss", "kind": f.kind,
+                               "step": step, "var": f.var,
+                               "detail": f"no chunk file to {f.kind} in "
+                                         f"{dirname}"})
+            continue
+        victim = _fsio.join(dirname, names[f._rng.randrange(len(names))])
+        data = _fsio.read_bytes(victim)
+        if not data:
+            continue
+        if f.kind == "corrupt":
+            pos = f._rng.randrange(len(data))
+            mutated = (data[:pos] + bytes([data[pos] ^ 0x01]) +
+                       data[pos + 1:])
+            detail = f"bit-flip at byte {pos}"
+        else:
+            mutated = data[:max(1, len(data) // 2)]
+            detail = f"truncated {len(data)} -> {len(mutated)} bytes"
+        _fsio.write_bytes(victim, mutated)
+        _record(f, "checkpoint_write", step, var=f.var)
+        applied.append({"kind": f.kind, "file": str(victim),
+                        "detail": detail})
+        _journal.emit({"event": "ckpt_fault", "kind": f.kind,
+                       "file": str(victim), "step": step,
+                       "detail": detail})
+    return applied
 
 
 def describe() -> List[dict]:

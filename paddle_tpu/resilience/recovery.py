@@ -402,7 +402,8 @@ class StepGuardian:
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, fetch_list=None,
-                           fuse_steps: int = 1, **kw):
+                           fuse_steps: int = 1, skip_batches: int = 0,
+                           epoch: int = 0, **kw):
         """One guarded epoch over a Dataset (each batch through
         :meth:`run`, prefetched like ``Executor.train_from_dataset``).
 
@@ -411,15 +412,41 @@ class StepGuardian:
         -- documented skip/rollback granularity becomes K steps.
         ``fuse_steps=0`` consults the autotuner's cached ``fuse_steps.k``
         decision (the guardian never searches: measurement belongs to the
-        unguarded loop)."""
+        unguarded loop).
+
+        Exact resume: the attached checkpointer's ``trainstate.json``
+        records, before every guarded step, the batch position the save
+        at that step boundary corresponds to (``epoch``, ``batch`` =
+        batches consumed including the step being run, ``fuse_steps``).
+        ``skip_batches=N`` fast-forwards a restored run past the batches
+        the checkpoint already consumed::
+
+            start = ck.restore() + 1
+            pos = ck.train_state or {}
+            g.train_from_dataset(dataset=ds, fuse_steps=k,
+                                 epoch=pos.get("epoch", 0),
+                                 skip_batches=pos.get("batch", 0))
+        """
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
         depth = self.exe._prefetch_depth(thread, dataset)
         k = int(fuse_steps)
         batches = dataset._iter_batches()
+        if skip_batches:
+            import itertools
+            batches = itertools.islice(batches, skip_batches, None)
         if k == 0:
             k, batches, _ = self.exe._resolve_fuse_steps(
                 batches, fetch_list or [])
+        consumed = int(skip_batches)
+        mark = getattr(self.checkpointer, "update_train_state", None)
+
+        def _mark(n_after: int):
+            # recorded BEFORE the step runs: maybe_save fires inside
+            # run()/run_fused() right after the state commits, and the
+            # position it must persist is "this chunk consumed"
+            if mark is not None:
+                mark(epoch=int(epoch), batch=n_after, fuse_steps=k)
         if k > 1:
             from ..framework import Program as _Program
             from ..framework import default_main_program
@@ -438,17 +465,23 @@ class StepGuardian:
         if k > 1:
             for item in self.exe._prefetch_batches(batches, depth, fuse=k):
                 if item[0] == "mega":
+                    _mark(consumed + item[2])
                     last = self.run_fused(program, stacked_feed=item[1],
                                           fetch_list=fetch_list,
                                           scope=scope, **kw)
+                    consumed += item[2]
                 else:
+                    _mark(consumed + 1)
                     last = self.run(program, feed=item[1],
                                     fetch_list=fetch_list, scope=scope,
                                     **kw)
+                    consumed += 1
         else:
             for feed in self.exe._prefetch_batches(batches, depth):
+                _mark(consumed + 1)
                 last = self.run(program, feed=feed, fetch_list=fetch_list,
                                 scope=scope, **kw)
+                consumed += 1
         return last
 
     def close(self):
@@ -623,12 +656,28 @@ class StepGuardian:
 
     def _emergency_exit(self):
         """Preemption flag is set: emergency-save at this step boundary,
-        journal, close, and raise Preempted (resumable exit)."""
+        journal, close, and raise Preempted (resumable exit).  A pending
+        ASYNC write is flushed synchronously first -- the process is about
+        to die, so the background writer must land (or its failure must be
+        known) before the emergency save decides what is still missing."""
         saved = None
         last = self.step - 1
         if self.checkpointer is not None and last >= 0:
+            flush = getattr(self.checkpointer, "wait", None)
+            if flush is not None:
+                try:
+                    self._checkpoint_with_retry(flush)
+                except Exception as e:  # noqa: BLE001 -- emergency path
+                    # a failed pending write must not abort the emergency
+                    # save; the sync save below rewrites the state
+                    _journal.emit({"event": "ckpt_save_error",
+                                   "step": self.step, "where": "preempt",
+                                   "error": f"{type(e).__name__}: {e}"})
             if getattr(self.checkpointer, "_last_save_step", None) != last:
-                self._checkpoint_with_retry(self.checkpointer.save, last)
+                # always synchronous: an async enqueue here would race
+                # process teardown
+                self._checkpoint_with_retry(
+                    lambda: self.checkpointer.save(last, async_=False))
             saved = last
             _OBS.counter("preemption_saves_total",
                          "emergency checkpoints written at preemption"

@@ -108,6 +108,21 @@ def write_bytes(path, data: bytes):
         f.write(data)
 
 
+def move(src, dst):
+    """Rename a file OR directory (``replace`` is file-shaped: fsspec mv
+    without recursive=True does not move directory trees).  Local is an
+    atomic os.replace; remote is mv/copy+delete like ``replace``."""
+    if is_remote(src) or is_remote(dst):
+        fs = _fs(dst)
+        try:
+            fs.mv(str(src), str(dst), recursive=True)
+        except Exception:
+            fs.copy(str(src), str(dst), recursive=True)
+            fs.rm(str(src), recursive=True)
+        return
+    os.replace(src, dst)
+
+
 def save_array(path, arr):
     """np.save through the hook (np.save writes to file objects)."""
     import numpy as np

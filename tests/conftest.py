@@ -81,6 +81,7 @@ SMOKE_TESTS = {
     "test_api_spec.py::test_api_matches_spec",
     "test_resilience.py::test_chaos_cli_selftest",
     "test_resilience.py::test_zero_overhead_when_disabled",
+    "test_checkpoint_durability.py::test_ckpt_doctor_selftest",
 }
 
 

@@ -156,6 +156,570 @@ def test_malformed_manifest_is_incomplete_not_a_crash(trained_tree):
         assert ck.latest_step() == 2
 
 
+# -- corruption matrix: detect, quarantine, fall through ---------------------
+
+def test_corruption_matrix_bitflip_every_chunk(trained_tree, tmp_path):
+    """Bit-flip EACH chunk of the newest checkpoint in turn (fresh copy of
+    the tree per victim): the flip passes the size scan, restore() detects
+    it via crc, quarantines ckpt-3, and lands on step 2 with step-2's
+    exact bytes -- never silently restores garbage."""
+    import shutil
+    main, startup = trained_tree["main"], trained_tree["startup"]
+    src = trained_tree["dir"]
+    chunks = _chunk_files(os.path.join(src, "ckpt-3"))
+    assert len(chunks) >= 3
+    for i, victim in enumerate(chunks):
+        tree = str(tmp_path / f"copy{i}")
+        shutil.copytree(src, tree)
+        p = os.path.join(tree, "ckpt-3", victim)
+        data = bytearray(open(p, "rb").read())
+        data[len(data) // 2] ^= 0x40
+        open(p, "wb").write(bytes(data))
+        exe = fluid.Executor()
+        ck = Checkpointer(exe, main, tree)
+        assert ck._is_complete(os.path.join(tree, "ckpt-3"))  # size scan
+        assert ck.latest_step() == 3      # cheap scan cannot see a flip
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            assert ck.restore() == 2, f"victim {victim}"
+            assert _state_bytes(scope, main) == trained_tree["states"][2]
+        assert os.path.isdir(os.path.join(tree, "ckpt-3.corrupt"))
+        assert not os.path.exists(os.path.join(tree, "ckpt-3"))
+
+
+def test_truncated_manifest_falls_through(trained_tree):
+    main, startup = trained_tree["main"], trained_tree["startup"]
+    p = os.path.join(trained_tree["dir"], "ckpt-3", "__manifest__.json")
+    raw = open(p).read()
+    open(p, "w").write(raw[:len(raw) // 2])   # torn JSON
+    exe = fluid.Executor()
+    ck = Checkpointer(exe, main, trained_tree["dir"])
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        assert ck.restore() == 2
+        assert _state_bytes(scope, main) == trained_tree["states"][2]
+
+
+def test_stale_latest_falls_through(trained_tree):
+    main, startup = trained_tree["main"], trained_tree["startup"]
+    with open(os.path.join(trained_tree["dir"], "LATEST"), "w") as f:
+        json.dump({"step": 999999, "time": 0}, f)
+    exe = fluid.Executor()
+    ck = Checkpointer(exe, main, trained_tree["dir"])
+    assert ck.latest_step() == 3
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        assert ck.restore() == 3
+        assert _state_bytes(scope, main) == trained_tree["states"][3]
+
+
+def test_injected_corrupt_fault_roundtrip(tmp_path):
+    """The chaos path end to end: a seeded ``corrupt@checkpoint_write``
+    fault damages the save's own files; the NEXT process's restore
+    detects, quarantines, and falls through to the undamaged step."""
+    from paddle_tpu.resilience import faults
+    main, startup, loss = _build(seed=5)
+    tree = str(tmp_path / "ck")
+    scope = fluid.Scope()
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            ck = Checkpointer(exe, main, tree)
+            exe.run(main, feed=_feed(0), fetch_list=[loss])
+            ck.save(1)
+            want = _state_bytes(scope, main)
+            faults.install("corrupt@checkpoint_write:step=2:seed=3")
+            exe.run(main, feed=_feed(1), fetch_list=[loss])
+            ck.save(2)
+            exe.close()
+        assert faults.active()[0].fired == 1
+    finally:
+        faults.clear()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor()
+        exe2.run(startup)
+        ck2 = Checkpointer(exe2, main, tree)
+        assert ck2.latest_step() == 2     # size scan passes the bit-flip
+        assert ck2.restore() == 1         # crc verify does not
+        assert _state_bytes(scope2, main) == want
+    ev = [e for e in _recent_events("ckpt_quarantine")]
+    assert ev and ev[-1]["step"] == 2
+
+
+def _recent_events(kind):
+    from paddle_tpu.observability import journal
+    return [e for e in journal.recent() if e.get("event") == kind]
+
+
+# -- async saves -------------------------------------------------------------
+
+def test_async_save_matches_sync_layout(trained_tree, tmp_path):
+    """async_=True writes the exact same checkpoint a sync save writes
+    (chunk bytes, manifest entries, trainstate), just off-thread."""
+    main = trained_tree["main"]
+    startup = trained_tree["startup"]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        cka = Checkpointer(exe, main, str(tmp_path / "a"))
+        ckb = Checkpointer(exe, main, str(tmp_path / "b"), async_save=True)
+        cka.save(7)
+        ckb.save(7)
+        ckb.wait()
+    da, db = str(tmp_path / "a" / "ckpt-7"), str(tmp_path / "b" / "ckpt-7")
+    assert _chunk_files(da) == _chunk_files(db)
+    for f in _chunk_files(da):
+        assert open(os.path.join(da, f), "rb").read() == \
+            open(os.path.join(db, f), "rb").read()
+    ma = json.load(open(os.path.join(da, "__manifest__.json")))
+    mb = json.load(open(os.path.join(db, "__manifest__.json")))
+    assert ma == mb
+    assert pio.verify_checkpoint(db, level="crc")["ok"]
+    ta = json.load(open(os.path.join(da, "trainstate.json")))
+    tb = json.load(open(os.path.join(db, "trainstate.json")))
+    assert ta == tb and ta["step"] == 7
+
+
+def test_async_backpressure_blocks_until_previous_lands(trained_tree,
+                                                        tmp_path,
+                                                        monkeypatch):
+    import threading
+    main, startup = trained_tree["main"], trained_tree["startup"]
+    gate, started = threading.Event(), threading.Event()
+    real = pio.write_snapshot
+
+    def slow(snap, dirname, filename=None):
+        started.set()
+        assert gate.wait(10)
+        return real(snap, dirname, filename)
+
+    monkeypatch.setattr(pio, "write_snapshot", slow)
+    tree = str(tmp_path / "ck_bp")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, tree, async_save=True)
+        ck.save(1)                      # writer parks on the gate
+        assert started.wait(10)
+        done = threading.Event()
+
+        def second():
+            with fluid.scope_guard(scope):   # scope stack is thread-local
+                ck.save(2)              # must block: backpressure
+            done.set()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        assert not done.wait(0.3), \
+            "second async save did not wait for the first write to land"
+        gate.set()
+        assert done.wait(10)
+        ck.close()
+    for step in (1, 2):
+        assert pio.verify_checkpoint(
+            os.path.join(tree, f"ckpt-{step}"), level="crc")["ok"]
+
+
+def test_async_error_surfaces_on_next_save_and_wait(trained_tree, tmp_path,
+                                                    monkeypatch):
+    main, startup = trained_tree["main"], trained_tree["startup"]
+    calls = {"n": 0}
+    real = pio.write_snapshot
+
+    def flaky(snap, dirname, filename=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("injected: disk full")
+        return real(snap, dirname, filename)
+
+    monkeypatch.setattr(pio, "write_snapshot", flaky)
+    tree = str(tmp_path / "ck_err")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, tree, async_save=True)
+        ck.save(1)                      # writer fails in the background
+        with pytest.raises(OSError, match="disk full"):
+            ck.save(2)                  # ...and surfaces HERE, not swallowed
+        ck.save(2)                      # checkpointer still usable
+        ck.wait()
+        assert ck.latest_step() == 2
+        assert not fsio.exists(os.path.join(tree, "ckpt-1",
+                                            "__manifest__.json"))
+        ck.close()
+    assert _recent_events("ckpt_save_error")
+
+
+def test_torn_async_save_killed_mid_write_falls_through(trained_tree,
+                                                        tmp_path,
+                                                        monkeypatch):
+    """An async writer that dies mid-write (some chunks written, no
+    manifest) leaves an incomplete dir: the error surfaces on wait(), the
+    scan rejects the torn step, and restore lands on the previous one."""
+    import shutil
+    main, startup = trained_tree["main"], trained_tree["startup"]
+    tree = str(tmp_path / "ck_torn")
+    shutil.copytree(trained_tree["dir"], tree)
+    real_write = pio._write_snap
+
+    def torn(dirname, snap):
+        real_write(dirname, snap)       # first chunk lands...
+        raise OSError("killed mid-write")
+
+    monkeypatch.setattr(pio, "_write_snap", torn)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, tree, async_save=True)
+        ck.save(9)
+        with pytest.raises(OSError, match="mid-write"):
+            ck.wait()
+        assert os.path.isdir(os.path.join(tree, "ckpt-9"))  # torn remains
+        assert ck.latest_step() == 3    # ...but is not a resume point
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor()
+        exe2.run(startup)
+        ck2 = Checkpointer(exe2, main, tree)
+        assert ck2.restore() == 3
+        assert _state_bytes(scope2, main) == trained_tree["states"][3]
+
+
+def test_async_off_by_default_and_guardian_flushes_on_preempt(tmp_path):
+    """async_save defaults to off; under preemption the guardian flushes
+    the pending async write synchronously before the emergency save."""
+    from paddle_tpu.resilience import recovery
+    assert Checkpointer(None, None, str(tmp_path)).async_save is False
+    main, startup, loss = _build(seed=9)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck"),
+                          save_interval_steps=1, async_save=True)
+        g = recovery.StepGuardian(exe, main, checkpointer=ck,
+                                  handle_signals=False)
+        g.run(feed=_feed(0), fetch_list=[loss])
+        g.run(feed=_feed(1), fetch_list=[loss])
+        recovery.request_preemption("test")
+        try:
+            with pytest.raises(recovery.Preempted) as pi:
+                g.run(feed=_feed(2), fetch_list=[loss])
+        finally:
+            recovery.clear_preemption()
+        assert pi.value.saved_step == 1
+        assert ck._writer is None       # pending write flushed
+        assert ck.latest_step() == 1
+        assert pio.verify_checkpoint(
+            str(tmp_path / "ck" / "ckpt-1"), level="crc")["ok"]
+
+
+def test_failed_async_write_still_emergency_saved_on_preempt(tmp_path,
+                                                             monkeypatch):
+    """If the pending async write for step N failed, the emergency exit
+    must NOT trust the cadence ('N already saved') -- it re-saves N
+    synchronously, so Preempted.saved_step names a checkpoint that
+    actually exists."""
+    from paddle_tpu.resilience import recovery
+    main, startup, loss = _build(seed=23)
+    fails = {"arm": False}
+    real = pio.write_snapshot
+
+    def flaky(snap, dirname, filename=None):
+        if fails["arm"]:
+            fails["arm"] = False
+            raise OSError("injected: store blip")
+        return real(snap, dirname, filename)
+
+    monkeypatch.setattr(pio, "write_snapshot", flaky)
+    tree = str(tmp_path / "ck")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, tree, save_interval_steps=1,
+                          async_save=True)
+        g = recovery.StepGuardian(exe, main, checkpointer=ck,
+                                  handle_signals=False)
+        g.run(feed=_feed(0), fetch_list=[loss])
+        fails["arm"] = True             # the save for step 1 will fail
+        g.run(feed=_feed(1), fetch_list=[loss])
+        recovery.request_preemption("test")
+        try:
+            with pytest.raises(recovery.Preempted) as pi:
+                g.run(feed=_feed(2), fetch_list=[loss])
+        finally:
+            recovery.clear_preemption()
+        assert pi.value.saved_step == 1
+    assert pio.verify_checkpoint(os.path.join(tree, "ckpt-1"),
+                                 level="crc")["ok"], \
+        "emergency save did not rewrite the failed step"
+
+
+# -- exact resume ------------------------------------------------------------
+
+def test_exact_resume_byte_identity_fused(tmp_path):
+    """The pinned exact-resume contract under fuse_steps=2: a run that
+    saves, is killed, and resumes from trainstate.json (rng counter +
+    batch position) commits byte-identical state to the uninterrupted
+    run."""
+    from paddle_tpu.resilience.recovery import StepGuardian
+    main, startup, loss = _build(seed=13)
+    batches = [_feed(i) for i in range(8)]
+
+    class _ListDataset:
+        def __init__(self, bs):
+            self.batches, self.thread_num = bs, 0
+
+        def _iter_batches(self):
+            yield from self.batches
+
+    def fresh():
+        main._rng_run_counter = 0
+        startup._rng_run_counter = 0
+
+    # run A: uninterrupted epoch, fused K=2
+    fresh()
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "a"),
+                          save_interval_steps=2)
+        g = StepGuardian(exe, main, checkpointer=ck, handle_signals=False)
+        g.train_from_dataset(dataset=_ListDataset(batches),
+                             fetch_list=[loss], fuse_steps=2)
+        want = _state_bytes(scope_a, main)
+        want_counter = main._rng_run_counter
+
+    # run B phase 1: first half of the epoch, then the process "dies"
+    fresh()
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "b"),
+                          save_interval_steps=2)
+        g = StepGuardian(exe, main, checkpointer=ck, handle_signals=False)
+        g.train_from_dataset(dataset=_ListDataset(batches[:4]),
+                             fetch_list=[loss], fuse_steps=2)
+    main._rng_run_counter = 12345       # clobbered by the "crash"
+
+    # run B phase 2: fresh executor+scope, exact resume from trainstate
+    scope_c = fluid.Scope()
+    with fluid.scope_guard(scope_c):
+        exe2 = fluid.Executor()
+        exe2.run(startup)
+        ck2 = Checkpointer(exe2, main, str(tmp_path / "b"),
+                           save_interval_steps=2)
+        start = ck2.restore()
+        assert start == 3               # steps 0..3 ran, saved at boundary
+        ts = ck2.train_state
+        assert ts["batch"] == 4 and ts["fuse_steps"] == 2
+        assert main._rng_run_counter == 4   # rewound for the exact fold
+        g2 = StepGuardian(exe2, main, checkpointer=ck2,
+                          handle_signals=False, start_step=start + 1)
+        g2.train_from_dataset(dataset=_ListDataset(batches),
+                              fetch_list=[loss], fuse_steps=2,
+                              skip_batches=ts["batch"],
+                              epoch=ts.get("epoch", 0))
+        got = _state_bytes(scope_c, main)
+        assert main._rng_run_counter == want_counter
+    assert got == want                  # byte-identical to uninterrupted
+
+
+def test_kill_during_async_save_chaos_losses_match(tmp_path):
+    """Acceptance: a chaos run preempted while async saves are in flight
+    resumes exactly -- post-resume losses equal the uninterrupted run's
+    (flush-then-emergency-save keeps the recovery point coherent)."""
+    from paddle_tpu.resilience import recovery
+    from paddle_tpu.resilience.recovery import StepGuardian
+    main, startup, loss = _build(seed=21)
+
+    def run_steps(g, lo, hi, losses):
+        for step in range(lo, hi):
+            v, = g.run(feed=_feed(step), fetch_list=[loss])
+            losses.append(np.asarray(v).tobytes())
+
+    def fresh():
+        main._rng_run_counter = 0
+        startup._rng_run_counter = 0
+
+    # run A: uninterrupted
+    fresh()
+    losses_a = []
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, handle_signals=False)
+        run_steps(g, 0, 10, losses_a)
+        want = _state_bytes(scope_a, main)
+
+    # run B: async saves every step, preempted at step 6 mid-flight
+    fresh()
+    losses_b = []
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck"),
+                          save_interval_steps=1, async_save=True)
+        g = StepGuardian(exe, main, checkpointer=ck, handle_signals=False)
+        run_steps(g, 0, 6, losses_b)
+        recovery.request_preemption("chaos kill")
+        try:
+            with pytest.raises(recovery.Preempted) as pi:
+                g.run(feed=_feed(6), fetch_list=[loss])
+        finally:
+            recovery.clear_preemption()
+        assert pi.value.saved_step == 5
+    main._rng_run_counter = 999         # clobbered by the "crash"
+    scope_c = fluid.Scope()
+    with fluid.scope_guard(scope_c):
+        exe2 = fluid.Executor()
+        exe2.run(startup)
+        ck2 = Checkpointer(exe2, main, str(tmp_path / "ck"))
+        start = ck2.restore()
+        assert start == 5
+        assert main._rng_run_counter == 6   # exact next fold
+        g2 = StepGuardian(exe2, main, checkpointer=ck2,
+                          handle_signals=False, start_step=start + 1)
+        run_steps(g2, 6, 10, losses_b)
+        got = _state_bytes(scope_c, main)
+    assert losses_b == losses_a         # byte-equal losses, every step
+    assert got == want
+
+
+def test_executor_skip_batches_fast_forward():
+    """Executor.train_from_dataset(skip_batches=N) == running only the
+    tail of the epoch."""
+    main, startup, loss = _build(seed=17)
+
+    class _ListDataset:
+        def __init__(self, bs):
+            self.batches, self.thread_num = bs, 0
+
+        def _iter_batches(self):
+            yield from self.batches
+
+    batches = [_feed(i) for i in range(6)]
+    outs = {}
+    for label, kw in (("skip", dict(skip_batches=4)), ("tail", {})):
+        main._rng_run_counter = 0
+        startup._rng_run_counter = 0
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            ds = _ListDataset(batches if label == "skip" else batches[4:])
+            exe.train_from_dataset(main, ds, fetch_list=[loss], **kw)
+            outs[label] = _state_bytes(scope, main)
+    assert outs["skip"] == outs["tail"]
+
+
+# -- doctor / CLI / satellites ----------------------------------------------
+
+def test_ckpt_doctor_selftest():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([sys.executable, "-m", "tools.ckpt_doctor",
+                        "--selftest"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ckpt doctor selftest: OK" in r.stdout
+
+
+def test_ckpt_doctor_verify_and_fuzz_cli(trained_tree):
+    from tools import ckpt_doctor
+    rep = ckpt_doctor.verify_tree(trained_tree["dir"], level="crc")
+    assert rep["ok"] and rep["latest_complete_step"] == 3
+    # text formatting + exit codes through main()
+    assert ckpt_doctor.main(["verify", trained_tree["dir"]]) == 0
+    d = os.path.join(trained_tree["dir"], "ckpt-3")
+    victim = os.path.join(d, _chunk_files(d)[0])
+    data = bytearray(open(victim, "rb").read())
+    data[0] ^= 0x02
+    open(victim, "wb").write(bytes(data))
+    assert ckpt_doctor.main(["verify", trained_tree["dir"]]) == 1
+    assert ckpt_doctor.main([]) == 2
+    # fuzz the (already bit-flipped) tree: every applied case must pass
+    rep = ckpt_doctor.fuzz_tree(trained_tree["dir"], seed=5)
+    assert rep["ok"], json.dumps(rep, indent=2)
+
+
+def test_predictor_rejects_unknown_and_mislengthed_inputs(tmp_path):
+    from paddle_tpu.inference import Predictor
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        y = fluid.layers.fc(x, 3)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"], [y], exe,
+                                      main)
+    p = Predictor(str(tmp_path / "m"))
+    xv = np.ones((2, 4), np.float32)
+    p.run({"x": xv})                                    # happy path
+    with pytest.raises(ValueError, match="unexpected inputs.*'xx'"):
+        p.run({"x": xv, "xx": xv})                      # typo'd extra key
+    with pytest.raises(ValueError, match="missing inputs"):
+        p.run({})
+    with pytest.raises(ValueError, match="2 positional inputs"):
+        p.run([xv, xv])                                 # silent-drop before
+
+
+def test_rotation_never_deletes_restored_step(trained_tree):
+    """Rank 0's rotation must not delete the step this process restored
+    from, even when it rotates out of the keep window."""
+    main, startup, loss = (trained_tree["main"], trained_tree["startup"],
+                           trained_tree["loss"])
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, trained_tree["dir"], max_to_keep=2)
+        assert ck.restore() == 3
+        for step in (4, 5, 6):
+            exe.run(main, feed=_feed(step), fetch_list=[loss])
+            ck.save(step)
+    kept = set(fsio.listdir(trained_tree["dir"]))
+    assert "ckpt-3" in kept             # restored step survives rotation
+    assert "ckpt-5" in kept and "ckpt-6" in kept
+    assert "ckpt-4" not in kept         # normal rotation still happens
+
+
+def test_checkpoint_metrics_and_journal(trained_tree, tmp_path):
+    from paddle_tpu.observability.metrics import REGISTRY
+    main, startup = trained_tree["main"], trained_tree["startup"]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, str(tmp_path / "ck_met"),
+                          async_save=True)
+        ck.save(1)
+        ck.wait()
+    ev = [e for e in _recent_events("ckpt_save") if e.get("step") == 1]
+    assert ev and ev[-1]["async"] and ev[-1]["bytes"] > 0
+    assert ev[-1]["blocked_ms"] >= 0 and ev[-1]["write_ms"] >= 0
+    fam = REGISTRY.get("checkpoint_bytes_total")
+    assert fam is not None
+    fam2 = REGISTRY.get("checkpoint_blocked_seconds")
+    assert fam2 is not None
+
+
 def test_old_format_checkpoint_still_restores(trained_tree):
     """v1 manifests (no format_version / sizes / crcs) restore with checks
     skipped -- forward compatibility for pre-existing checkpoint trees."""

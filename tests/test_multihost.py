@@ -128,6 +128,36 @@ def test_multihost_sharded_checkpoint_reshard(tmp_path):
     assert (multi_dir / "__manifest__.json.rank1").exists()
 
 
+_CKPT_RUNNER = os.path.join(os.path.dirname(__file__),
+                            "dist_ckpt_runner.py")
+
+
+@requires_multiprocess_backend
+def test_multihost_checkpointer_save_restore(tmp_path):
+    """2-host ZeRO run under a Checkpointer: every rank writes its own
+    chunk manifest, rank 0 publishes LATEST and rotates only after the
+    post-save barrier, and a per-rank state digest survives the
+    save -> restore round trip exactly.  The surviving tree passes the
+    crc verifier (ISSUE 9 durable-checkpoint contract, multi-host)."""
+    tree = tmp_path / "ck"
+    outs = _launch(2, _free_port(), tree, runner=_CKPT_RUNNER)
+    for out in outs:
+        d = _tagged(out, "DIGESTS")
+        assert d["saved"] == d["restored"], \
+            f"rank {d['rank']} state changed across save/restore"
+    kept = sorted(p.name for p in tree.iterdir()
+                  if p.name.startswith("ckpt-"))
+    assert kept == ["ckpt-1", "ckpt-2"], kept   # max_to_keep=2 rotation
+    # both ranks' manifests + chunks verify clean at crc level
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools import ckpt_doctor
+    rep = ckpt_doctor.verify_tree(str(tree), level="crc")
+    assert rep["ok"] and rep["latest_complete_step"] == 2, rep
+    assert any(s["nranks"] == 2 for s in rep["steps"]), rep
+
+
 def test_pipeline_spmd_matches_serial():
     """Explicit GPipe over pp=4: outputs equal serial stage application."""
     import jax

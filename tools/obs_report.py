@@ -178,6 +178,58 @@ def render_resilience(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------- checkpoint --
+
+_CKPT_EVENTS = ("ckpt_save", "ckpt_corrupt", "ckpt_quarantine",
+                "ckpt_save_error", "ckpt_fault")
+
+
+def render_checkpoint(events: List[dict],
+                      snapshot: Optional[dict] = None) -> str:
+    """Durable-checkpoint activity: saves (blocked vs write time, sync vs
+    async), bytes written, detected corruption and quarantines
+    (utils/checkpointer.py + io.py integrity layer)."""
+    lines = ["== Checkpoint =="]
+    by = {k: [e for e in events if e.get("event") == k]
+          for k in _CKPT_EVENTS}
+    if not any(by.values()):
+        lines.append("quiet: no checkpoint save/corruption events")
+        return "\n".join(lines)
+    saves = by["ckpt_save"]
+    for label, pick in (("sync", [e for e in saves if not e.get("async")]),
+                        ("async", [e for e in saves if e.get("async")])):
+        if not pick:
+            continue
+        blocked = [e["blocked_ms"] for e in pick
+                   if e.get("blocked_ms") is not None]
+        write = [e["write_ms"] for e in pick
+                 if e.get("write_ms") is not None]
+        nbytes = sum(int(e.get("bytes") or 0) for e in pick)
+        lines.append(f"{len(pick)} {label} save(s), {_gb(float(nbytes))} "
+                     f"written")
+        if blocked:
+            lines.append(f"  blocked ms/save: {_stats(blocked)}")
+        if write and label == "async":
+            lines.append(f"  write ms/save (background): {_stats(write)}")
+    total = _counter_total(snapshot, "checkpoint_bytes_total")
+    if total is not None:
+        lines.append(f"checkpoint_bytes_total: {_gb(total)}")
+    for e in by["ckpt_corrupt"][-10:]:
+        lines.append(f"CORRUPT chunk detected ({e.get('kind')}): "
+                     f"{e.get('file')} var {e.get('var')!r} -- "
+                     f"{str(e.get('detail', ''))[:80]}")
+    for e in by["ckpt_quarantine"][-10:]:
+        lines.append(f"QUARANTINE step {e.get('step')} ({e.get('kind')}) "
+                     f"-> {e.get('to')}")
+    for e in by["ckpt_save_error"][-10:]:
+        lines.append(f"SAVE ERROR at step {e.get('step')}: "
+                     f"{str(e.get('error', ''))[:100]}")
+    for e in by["ckpt_fault"][-10:]:
+        lines.append(f"injected {e.get('kind')} on {e.get('file')} "
+                     f"({e.get('detail')})")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- megastep --
 
 def _counter_total(snapshot: Optional[dict], name: str) -> Optional[float]:
@@ -385,6 +437,7 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_megastep(events, snapshot))
         parts.append(render_health(events))
         parts.append(render_resilience(events))
+        parts.append(render_checkpoint(events, snapshot))
     if trace_events is not None:
         parts.append(render_timeline(trace_events))
     if snapshot is not None:
@@ -471,6 +524,15 @@ def selftest() -> int:
         {"event": "elastic_restart", "attempt": 1, "max_restarts": 2,
          "failed_rank": 1, "exit_codes": [None, 3], "backoff_s": 1.4,
          "ts": 9.0},
+        # checkpoint section (durable checkpointing)
+        {"event": "ckpt_save", "step": 6, "async": False, "bytes": 4096,
+         "blocked_ms": 12.0, "write_ms": 12.0, "ts": 9.5},
+        {"event": "ckpt_save", "step": 8, "async": True, "bytes": 4096,
+         "blocked_ms": 0.8, "write_ms": 11.0, "ts": 9.6},
+        {"event": "ckpt_corrupt", "kind": "crc", "file": "ck/ckpt-8/w.npy",
+         "var": "w", "detail": "crc32 1, manifest says 2", "ts": 9.7},
+        {"event": "ckpt_quarantine", "step": 8, "kind": "crc",
+         "to": "ck/ckpt-8.corrupt", "reason": "crc mismatch", "ts": 9.8},
     ]
 
     # a synthetic flight-recorder trace through the real exporter
@@ -530,6 +592,11 @@ def selftest() -> int:
                      "PREEMPT at step 7: emergency checkpoint step 6",
                      "1 elastic restart(s)", "rank 1 failed",
                      "fault_injected_total", "steps_skipped_total",
+                     # checkpoint section
+                     "1 sync save(s)", "1 async save(s)",
+                     "write ms/save (background)",
+                     "CORRUPT chunk detected (crc)",
+                     "QUARANTINE step 8 (crc) -> ck/ckpt-8.corrupt",
                      # memory section (incl. the static-planner comparison)
                      "cpu:0", "512.000 MB", "peak 1.500 GB",
                      "static plan 1.800 GB", "(1.20x of XLA)",
@@ -543,6 +610,7 @@ def selftest() -> int:
         # empty journal/trace render degrades, never raises
         assert "healthy" in render_health([])
         assert "quiet" in render_resilience([])
+        assert "quiet" in render_checkpoint([])
         assert "unfused" in render_megastep([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})

@@ -34,6 +34,8 @@ MODULES = [
     "paddle_tpu.analysis",
     "paddle_tpu.tuning",
     "paddle_tpu.resilience",
+    "paddle_tpu.utils.checkpointer",
+    "tools.ckpt_doctor",
 ]
 
 
@@ -54,9 +56,10 @@ def iter_api():
             obj = getattr(mod, name)
             if inspect.ismodule(obj):
                 continue
-            # only symbols that belong to the package (not re-exported numpy etc.)
+            # only symbols that belong to the repo (not re-exported numpy
+            # etc.); tools.* CLIs are pinned alongside the package
             owner = getattr(obj, "__module__", "") or ""
-            if not owner.startswith("paddle_tpu"):
+            if owner.split(".")[0] not in ("paddle_tpu", "tools"):
                 continue
             if inspect.isclass(obj):
                 yield f"{modname}.{name} class{_signature(obj)}"
