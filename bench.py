@@ -510,6 +510,22 @@ if __name__ == "__main__":
         from paddle_tpu import profiler as _prof
         _prof.stop_profiler(profile_path=os.devnull)
     if _args.emit_metrics:
+        # goodput breakdown rides along: classify this process's wall-clock
+        # (ledger over the always-on phase spans + journal -- no extra
+        # timers ran), publish the gauges/counters into the registry so the
+        # dump carries them, and print the per-run summary as a metric line
+        from paddle_tpu.observability import goodput as _goodput
+        _gr = _goodput.export(_goodput.compute_live())
+        print(json.dumps({
+            "metric": "goodput_fraction",
+            "value": round(_gr.goodput_fraction, 4),
+            "unit": "fraction of wall-clock spent in productive step "
+                    "execution",
+            "vs_baseline": None,
+            "wall_seconds": round(_gr.wall_seconds, 3),
+            "lost_seconds": {c: round(s, 3)
+                             for c, s in sorted(_gr.lost.items()) if s},
+        }), flush=True)
         from paddle_tpu.observability import export as _obs_export
         _obs_export.dump_json(_args.emit_metrics)
         print(f"[bench] metrics registry written to {_args.emit_metrics}",

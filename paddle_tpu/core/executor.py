@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..framework import (Program, Block, Variable, default_main_program)
+from ..observability import fleet as _obs_fleet
 from ..observability import journal as _obs_journal
 from ..observability import timeline as _obs_timeline
 from ..observability.metrics import REGISTRY as _OBS
@@ -275,7 +276,6 @@ class Executor:
         import collections
         self.place = place
         self._closing = False   # re-entrancy guard for signal-safe close()
-        Executor._instances.add(self)
         self._cache: "collections.OrderedDict[Tuple, _CompiledStep]" = \
             collections.OrderedDict()
         # last compile-key components per Program, for the recompile detector
@@ -290,6 +290,28 @@ class Executor:
         # key the memo). The diags are kept so raise-mode can re-apply its
         # policy on retries of a failing program.
         self._verified: Dict[Tuple, Tuple[Program, list]] = {}
+        # Fleet-telemetry arming points LAST -- the weak registry and the
+        # hooks only see fully-constructed executors (a raised typo'd-env
+        # ValueError must not leave a half-built instance in _instances
+        # for _retire_program_gauges_if_dead to trip over).  With
+        # PADDLE_TPU_OBS_PORT / PADDLE_TPU_FLEET unset each hook is one
+        # env read -- no socket, no thread, no per-step work
+        # (guard-tested); armed, only a typo'd mode may abort
+        # construction.
+        try:
+            from ..observability import server as _obs_server
+            _obs_server.maybe_start()
+        except Exception as e:
+            import warnings
+            warnings.warn(f"paddle_tpu metrics endpoint disabled: {e}")
+        try:
+            _obs_fleet.maybe_arm()
+        except ValueError:
+            raise   # typo'd mode/interval: never silently degrade (PR-3 rule)
+        except Exception as e:
+            import warnings
+            warnings.warn(f"paddle_tpu fleet telemetry disabled: {e}")
+        Executor._instances.add(self)
 
     def _maybe_verify(self, program: Program, feed_names, fetch_names,
                       wrapper=None, feed_shapes=None, fuse_k=None):
@@ -353,11 +375,17 @@ class Executor:
             diags = prev[1]
             counts = analysis.count_by_severity(diags)
         else:
+            t0 = time.perf_counter()
             diags = analysis.verify(program, feed_names=feed_names,
                                     fetch_names=fetch_names,
                                     strategy=strategy,
                                     mem_budget=mem_budget, batch=batch,
                                     fuse_k=fuse_k)
+            # compile-miss-path span (never per-step): the goodput ledger
+            # attributes verifier time as its own loss cause
+            _obs_timeline.record_span("verify", t0,
+                                      time.perf_counter() - t0,
+                                      program=id(program))
             self._verified[vkey] = (program, diags)
             while len(self._verified) > self._CACHE_CAP:
                 self._verified.pop(next(iter(self._verified)))
@@ -805,6 +833,13 @@ class Executor:
             # would crater them)
             from ..observability import cost as _obs_cost
             _obs_cost.update_cost_gauges(compiled, run_s, label)
+        if _obs_fleet.MONITOR is not None:
+            # fleet cadence: warm inter-step wall time feeds the straggler
+            # detector; gather-mode collections key on the program's step
+            # index (retry/rollback rewinds included) so every rank hits
+            # the collective at the same committed step
+            _obs_fleet.MONITOR.on_step(
+                warm=not was_miss and not fallback_retraced, step=step_idx)
         if obs_on:
             self._obs_step = getattr(self, "_obs_step", 0) + 1
             from ..observability import memory as _obs_memory
@@ -1097,6 +1132,10 @@ class Executor:
             # time never shares a median with K=1 steps of the same program
             from ..observability import anomaly as _obs_anomaly
             _obs_anomaly.DETECTOR.observe(label, amortized, key=key)
+        if _obs_fleet.MONITOR is not None:
+            _obs_fleet.MONITOR.on_step(
+                warm=not was_miss and not fallback_retraced, k=k,
+                step=step_idx)
         if obs_on:
             self._obs_step = getattr(self, "_obs_step", 0) + 1
             from ..observability import memory as _obs_memory

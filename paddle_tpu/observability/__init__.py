@@ -17,6 +17,12 @@ reproduction gets the counterpart the whole-program-jit design enables:
 - ``memory``   -- device memory_stats()/live-buffer gauges + per-program
   ``memory_analysis()`` peak bytes.
 - ``anomaly``  -- rolling median/MAD step-time regression detector.
+- ``goodput``  -- wall-clock ledger: productive step time vs named loss
+  causes, ``goodput_fraction`` + ``lost_seconds_total{cause}``.
+- ``server``   -- opt-in live endpoint (``PADDLE_TPU_OBS_PORT``):
+  ``/metrics`` ``/healthz`` ``/goodput`` ``/journal``.
+- ``fleet``    -- cross-rank aggregation + straggler detection
+  (``PADDLE_TPU_FLEET=gather|scrape``).
 
 Render everything with ``python -m tools.obs_report``.
 """
@@ -28,9 +34,22 @@ from . import timeline  # noqa: F401
 from . import health  # noqa: F401
 from . import memory  # noqa: F401
 from . import anomaly  # noqa: F401
+from . import goodput  # noqa: F401
+from . import server  # noqa: F401
+from . import fleet  # noqa: F401
 from .metrics import (REGISTRY, MetricsRegistry, Counter, Gauge,  # noqa: F401
                       Histogram)
 from .export import to_json, to_prometheus, parse_prometheus  # noqa: F401
-from .journal import enabled, emit, recent, read_journal  # noqa: F401
+from .journal import (enabled, emit, recent, read_journal,  # noqa: F401
+                      current_rank)
 from .timeline import (phase, export_chrome_trace,  # noqa: F401
                        validate_trace)
+from .goodput import (GoodputReport,  # noqa: F401
+                      compute as compute_goodput,
+                      compute_live as compute_goodput_live,
+                      run_ledger,
+                      export as export_goodput)
+from .server import (ObsServer,  # noqa: F401
+                     start as start_server,
+                     stop as stop_server)
+from .fleet import FleetMonitor, detect_stragglers  # noqa: F401

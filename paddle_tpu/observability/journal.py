@@ -79,6 +79,28 @@ def enabled() -> bool:
     return env_truthy("PADDLE_TPU_OBS")
 
 
+# process rank for multi-rank event attribution: None = not yet computed,
+# False = single-process (no field stamped), int = this process's rank.
+# Computed once per process (the launcher contract pins rank/world at
+# spawn); clear() resets it so tests can re-stage the env.
+_rank_cache = None
+
+
+def current_rank() -> Optional[int]:
+    """This process's rank when part of a multi-rank job, else None.
+    Merged multi-rank journals attribute events by the ``rank`` field
+    this stamps; single-process journals stay byte-identical to before."""
+    global _rank_cache
+    if _rank_cache is None:
+        try:
+            from ..parallel import env as _penv
+            _rank_cache = (_penv.get_rank()
+                           if _penv.get_world_size() > 1 else False)
+        except Exception:
+            _rank_cache = False
+    return None if _rank_cache is False else _rank_cache
+
+
 def journal_path() -> str:
     return os.environ.get("PADDLE_TPU_OBS_JOURNAL", DEFAULT_JOURNAL)
 
@@ -92,6 +114,9 @@ def emit(event: dict) -> dict:
     ev = dict(event)
     ev.setdefault("ts", time.time())
     ev.setdefault("pid", os.getpid())
+    r = current_rank()
+    if r is not None:
+        ev.setdefault("rank", r)
     with _lock:
         _ring.append(ev)
     if enabled():
@@ -123,9 +148,11 @@ def recent(n: Optional[int] = None, event: Optional[str] = None) -> List[dict]:
 
 
 def clear():
+    global _rank_cache
     with _lock:
         _ring.clear()
     _broken_paths.clear()
+    _rank_cache = None
 
 
 def read_journal(path: Optional[str] = None) -> List[dict]:

@@ -44,6 +44,12 @@ _lock = threading.Lock()
 _spans: "collections.deque" = collections.deque(maxlen=_SPAN_CAP)
 # (track_name, t_seconds, {series: value})
 _counters: "collections.deque" = collections.deque(maxlen=_SPAN_CAP)
+# [earliest span start, latest span end] over the executor/dataset
+# categories, for the WHOLE process -- the ring above is bounded (~13k
+# steps), so anything deriving a run window from ring contents alone
+# (the goodput ledger) would silently shrink its wall-clock once the
+# ring wraps while the cumulative phase_seconds sums keep growing
+_window = [None, None]
 
 
 @contextlib.contextmanager
@@ -72,6 +78,12 @@ def record_span(name: str, t0: float, dur: float, cat: str = "executor",
         # land on separate trace tracks, not garble one tid-0 line
         _spans.append((name, cat, t0, dur, args or None,
                        threading.get_ident()))
+        if cat in ("executor", "dataset"):
+            if _window[0] is None or t0 < _window[0]:
+                _window[0] = t0
+            end = t0 + max(dur, 0.0)
+            if _window[1] is None or end > _window[1]:
+                _window[1] = end
     from .metrics import REGISTRY
     REGISTRY.histogram("phase_seconds",
                        "flight-recorder phase durations by phase and "
@@ -102,19 +114,35 @@ def counters(track: Optional[str] = None) -> List[tuple]:
     return out
 
 
+def span_window():
+    """(earliest start, latest end) perf_counter pair over every
+    executor/dataset span this process EVER recorded -- survives ring
+    wrap, unlike reading the ring.  (None, None) before the first span."""
+    with _lock:
+        return (_window[0], _window[1])
+
+
 def clear():
     with _lock:
         _spans.clear()
         _counters.clear()
+        _window[0] = _window[1] = None
 
 
 def _trace_events(host_pid: int = PID_PHASES) -> List[dict]:
-    """The ring contents as trace-event dicts (ts/dur in microseconds)."""
+    """The ring contents as trace-event dicts (ts/dur in microseconds).
+
+    Under a multi-rank job the process tracks are rank-tagged, so
+    per-rank exports merged by ``profiler.merge_chrome_traces`` keep
+    distinct, attributable track names instead of N identical lines."""
+    from .journal import current_rank
+    r = current_rank()
+    tag = "" if r is None else f" [rank {r}]"
     events: List[dict] = [
         {"ph": "M", "pid": host_pid, "name": "process_name",
-         "args": {"name": "paddle_tpu flight recorder (phases)"}},
+         "args": {"name": f"paddle_tpu flight recorder (phases){tag}"}},
         {"ph": "M", "pid": PID_COUNTERS, "name": "process_name",
-         "args": {"name": "paddle_tpu telemetry (counters)"}},
+         "args": {"name": f"paddle_tpu telemetry (counters){tag}"}},
     ]
     with _lock:
         span_list = list(_spans)

@@ -54,10 +54,33 @@ def launch(nproc: int, script_argv, coordinator: str = None,
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
     import random
     import time
+
+    # Restart DOWNTIME (kill -> respawned job) is measured, not just
+    # counted: the goodput ledger needs elastic-restart seconds as a named
+    # loss cause.  t0 is stamped when a failed attempt's ranks are all
+    # reaped; the clock stops when the NEXT attempt's ranks are all
+    # spawned (the ranks' own re-init/compile shows up in their journals
+    # as compile time, attributed separately).
+    down = {"t0": None, "attempt": 0}
+
+    def _respawned():
+        if down["t0"] is None:
+            return
+        downtime = time.perf_counter() - down["t0"]
+        down["t0"] = None
+        from ..observability import journal as _journal
+        from ..observability.metrics import REGISTRY as _OBS
+        _OBS.counter("lost_seconds_total",
+                     "goodput ledger: wall-clock seconds lost, by cause",
+                     cause="elastic_restart").inc(downtime)
+        _journal.emit({"event": "elastic_restart_downtime",
+                       "attempt": down["attempt"],
+                       "downtime_s": round(downtime, 3)})
+
     for attempt in range(max_restarts + 1):
         codes = _launch_once(nproc, script_argv, coordinator,
                              devices_per_proc, log_dir, poll_interval,
-                             attempt)
+                             attempt, spawned_cb=_respawned)
         if all(c == 0 for c in codes) or attempt == max_restarts:
             return codes
         # Exponential backoff with jitter between restarts: an immediate
@@ -91,11 +114,13 @@ def launch(nproc: int, script_argv, coordinator: str = None,
             f"{culprit if culprit is not None else '?'}); restarting the "
             f"job from the latest checkpoint in {delay:.1f}s "
             f"({attempt + 1}/{max_restarts} restarts used)\n")
+        down["t0"] = time.perf_counter()
+        down["attempt"] = attempt + 1
         time.sleep(delay)
 
 
 def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
-                 poll_interval, attempt):
+                 poll_interval, attempt, spawned_cb=None):
     import time
     if coordinator:
         host, port0 = coordinator.rsplit(":", 1)
@@ -134,6 +159,8 @@ def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
                                           env=env, stdout=lf, stderr=lf))
         finally:
             lf.close()   # the child holds its own copy of the fd
+    if spawned_cb is not None:
+        spawned_cb()   # all ranks spawned: the restart-downtime clock stops
     # monitor: a dead rank must not leave the others hanging in a collective
     while True:
         codes = [p.poll() for p in procs]

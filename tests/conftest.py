@@ -82,6 +82,8 @@ SMOKE_TESTS = {
     "test_resilience.py::test_chaos_cli_selftest",
     "test_resilience.py::test_zero_overhead_when_disabled",
     "test_checkpoint_durability.py::test_ckpt_doctor_selftest",
+    "test_observability.py::test_obs_report_cli_selftest",
+    "test_fleet_telemetry.py::test_zero_overhead_when_disarmed",
 }
 
 

@@ -339,6 +339,76 @@ def render_memory(snapshot: dict) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------- goodput --
+
+def render_goodput(events: Optional[List[dict]],
+                   snapshot: Optional[dict]) -> str:
+    """Wall-clock ledger: productive step time vs named loss causes
+    (paddle_tpu/observability/goodput.py), computed from whatever the
+    caller loaded -- journal alone degrades to run/compile attribution,
+    a metrics snapshot adds the per-phase split."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.observability import goodput as _goodput
+    lines = ["== Goodput =="]
+    rep = _goodput.compute(events=events, snapshot=snapshot)
+    lines.append(rep.summary())
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ fleet --
+
+def render_fleet(events: Optional[List[dict]]) -> str:
+    """Cross-rank view: the last fleet collection's per-rank step-time
+    table, straggler verdicts, and elastic-restart downtime
+    (paddle_tpu/observability/fleet.py + parallel/launch.py)."""
+    lines = ["== Fleet =="]
+    events = events or []
+    fleets = [e for e in events if e.get("event") == "fleet"]
+    stragglers = [e for e in events if e.get("event") == "straggler"]
+    restarts = [e for e in events if e.get("event") == "elastic_restart"]
+    downtimes = [e for e in events
+                 if e.get("event") == "elastic_restart_downtime"]
+    if not fleets and not stragglers and not restarts:
+        lines.append("single-rank: no fleet/straggler events (arm "
+                     "PADDLE_TPU_FLEET=gather|scrape under "
+                     "parallel.launch)")
+        return "\n".join(lines)
+    if fleets:
+        last = fleets[-1]
+        lines.append(f"{len(fleets)} collection(s) "
+                     f"[{last.get('transport', '?')}]; last: "
+                     f"{last.get('n_ranks')} rank(s), median "
+                     f"{last.get('median_ms')}ms, skew "
+                     f"{last.get('skew')}x")
+        for r in last.get("ranks", []):
+            mark = " STRAGGLER" if r.get("rank") in \
+                (last.get("stragglers") or []) else ""
+            lines.append(
+                f"  rank {r.get('rank')} ({r.get('host')}): step "
+                f"{r.get('step_ms')}ms (MAD {r.get('mad_ms')}ms, "
+                f"n={r.get('n')}), {r.get('steps')} steps, "
+                f"{r.get('restarts')} restart(s){mark}")
+    if stragglers:
+        lines.append(f"{len(stragglers)} straggler verdict(s) (last 10):")
+        for e in stragglers[-10:]:
+            lines.append(
+                f"  STRAGGLER rank {e.get('rank')}: {e.get('step_ms')}ms "
+                f"vs fleet median {e.get('median_ms')}ms "
+                f"(limit {e.get('limit_ms')}ms)")
+    if restarts or downtimes:
+        lost = sum(float(e.get("downtime_s") or 0.0) for e in downtimes)
+        lines.append(f"{len(restarts)} elastic restart(s), "
+                     f"{lost:.1f}s measured downtime")
+        by_rank = {}
+        for e in restarts:
+            r = e.get("failed_rank")
+            by_rank[r] = by_rank.get(r, 0) + 1
+        for r, n in sorted(by_rank.items(), key=lambda kv: str(kv[0])):
+            lines.append(f"  rank {r}: {n} failure(s)")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- timeline --
 
 def render_timeline(trace_events: List[dict]) -> str:
@@ -430,7 +500,8 @@ def load_metrics(path: str) -> dict:
 
 def render_report(events: Optional[List[dict]],
                   snapshot: Optional[dict],
-                  trace_events: Optional[List[dict]] = None) -> str:
+                  trace_events: Optional[List[dict]] = None,
+                  goodput: bool = False, fleet: bool = False) -> str:
     parts = ["# paddle_tpu observability report"]
     if events is not None:
         parts.append(render_journal(events))
@@ -438,6 +509,10 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_health(events))
         parts.append(render_resilience(events))
         parts.append(render_checkpoint(events, snapshot))
+    if goodput:
+        parts.append(render_goodput(events, snapshot))
+    if fleet:
+        parts.append(render_fleet(events))
     if trace_events is not None:
         parts.append(render_timeline(trace_events))
     if snapshot is not None:
@@ -485,6 +560,17 @@ def selftest() -> int:
     reg.counter("steps_skipped_total").inc()
     reg.counter("rollback_total").inc()
     reg.counter("preemption_saves_total").inc()
+    # goodput section sources: per-phase second sums (10s wall below:
+    # 6s dispatch+sync productive, 0.8s compile, 0.5s prefetch stalls ...)
+    for phase, cat, secs in (("dispatch", "executor", 4.0),
+                             ("fetch_sync", "executor", 2.0),
+                             ("feed_prep", "executor", 0.3),
+                             ("journal", "executor", 0.1),
+                             ("compile", "executor", 0.8),
+                             ("verify", "executor", 0.05),
+                             ("feed_wait", "dataset", 0.5)):
+        reg.histogram("phase_seconds", phase=phase, cat=cat).observe(secs)
+    reg.counter("straggler_total", rank="1").inc()
 
     events = [
         {"event": "run", "program": 1, "version": 0, "cache": "miss",
@@ -524,6 +610,18 @@ def selftest() -> int:
         {"event": "elastic_restart", "attempt": 1, "max_restarts": 2,
          "failed_rank": 1, "exit_codes": [None, 3], "backoff_s": 1.4,
          "ts": 9.0},
+        {"event": "elastic_restart_downtime", "attempt": 1,
+         "downtime_s": 1.2, "ts": 9.1},
+        # fleet section (cross-rank aggregation + straggler detection)
+        {"event": "fleet", "transport": "gather", "n_ranks": 2,
+         "median_ms": 4.2, "skew": 3.1, "stragglers": [1],
+         "ranks": [{"rank": 0, "host": "h0", "step_ms": 4.2, "mad_ms": 0.2,
+                    "n": 16, "steps": 64, "restarts": 0},
+                   {"rank": 1, "host": "h1", "step_ms": 13.0, "mad_ms": 0.3,
+                    "n": 16, "steps": 64, "restarts": 1}], "ts": 9.2},
+        {"event": "straggler", "rank": 1, "host": "h1", "step_ms": 13.0,
+         "median_ms": 4.2, "mad_ms": 0.2, "limit_ms": 5.9, "n_ranks": 2,
+         "ts": 9.3},
         # checkpoint section (durable checkpointing)
         {"event": "ckpt_save", "step": 6, "async": False, "bytes": 4096,
          "blocked_ms": 12.0, "write_ms": 12.0, "ts": 9.5},
@@ -573,7 +671,7 @@ def selftest() -> int:
 
         from paddle_tpu.observability.journal import read_journal
         report = render_report(read_journal(jpath), load_metrics(mpath),
-                               load_trace(tpath))
+                               load_trace(tpath), goodput=True, fleet=True)
         for must in ("2 executor runs", "1 recompiles", "hit rate",
                      "changed ['shape']", "program_mfu", "0.42",
                      "executor_run_seconds", "n=4",
@@ -597,6 +695,14 @@ def selftest() -> int:
                      "write ms/save (background)",
                      "CORRUPT chunk detected (crc)",
                      "QUARANTINE step 8 (crc) -> ck/ckpt-8.corrupt",
+                     # goodput section (wall-clock ledger)
+                     "== Goodput ==", "-> goodput",
+                     "dispatch + fetch_sync", "lost compile",
+                     "lost feed_wait", "lost elastic_restart",
+                     # fleet section (cross-rank view)
+                     "== Fleet ==", "1 collection(s) [gather]",
+                     "rank 1 (h1): step 13.0ms", "STRAGGLER rank 1",
+                     "1 elastic restart(s), 1.2s measured downtime",
                      # memory section (incl. the static-planner comparison)
                      "cpu:0", "512.000 MB", "peak 1.500 GB",
                      "static plan 1.800 GB", "(1.20x of XLA)",
@@ -614,6 +720,8 @@ def selftest() -> int:
         assert "unfused" in render_megastep([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})
+        assert "no goodput window" in render_goodput([], None)
+        assert "single-rank" in render_fleet([])
     print("obs_report selftest: OK")
     return 0
 
@@ -634,6 +742,15 @@ def main(argv=None) -> int:
                          "as a per-phase timeline section")
     ap.add_argument("--live", action="store_true",
                     help="render this process's in-memory registry")
+    ap.add_argument("--goodput", action="store_true",
+                    help="add the Goodput section: classify the run's "
+                         "wall-clock into productive step time vs named "
+                         "loss causes (compile, prefetch stalls, "
+                         "checkpoint, retries, elastic restarts, ...)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="add the Fleet section: per-rank step times, "
+                         "skew, straggler verdicts and elastic-restart "
+                         "downtime from a merged multi-rank journal")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
@@ -659,7 +776,8 @@ def main(argv=None) -> int:
     if events is None and snapshot is None and trace_events is None:
         ap.error("nothing to report: pass --journal, --metrics and/or "
                  "--trace (or --live), or run with PADDLE_TPU_OBS=1 first")
-    print(render_report(events, snapshot, trace_events))
+    print(render_report(events, snapshot, trace_events,
+                        goodput=args.goodput, fleet=args.fleet))
     return 0
 
 
