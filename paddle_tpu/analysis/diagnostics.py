@@ -100,6 +100,11 @@ CODES: Dict[str, tuple] = {
                              "ZeRO-sharded params are all-gathered at every "
                              "use (or stay replicated, losing the memory "
                              "win)"),
+    "PT047": (Severity.WARN, "strategy pins an assumption that breaks "
+                             "under an elastic resize: a data var's batch "
+                             "dim is hardcoded to a multiple of the "
+                             "current world size; a resized world that "
+                             "does not divide it will reject every feed"),
     # -- static memory planning (memplan.py) -------------------------------
     "PT050": (Severity.INFO, "static peak-memory estimate for the program "
                              "(liveness over the IR, sharding divisors and "

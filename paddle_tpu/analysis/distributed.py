@@ -30,6 +30,12 @@ DistributedStrategy)` pair:
   parameter at each use (ZeRO-3's bandwidth bill, estimated in bytes), and
   Reduce-mode state that cannot shard (no dim divides dp) silently stays
   replicated, losing the memory win.
+- PT047 (warn): elastic incompatibility -- a data var's batch dim is
+  hardcoded to a multiple of the current data-parallel degree.  It works
+  until the first rank loss: an elastic resize (``launch.py --elastic``)
+  to a world that does not divide the batch rejects every feed.  Flagged
+  before the first kill, while the fix (a dynamic ``-1`` batch dim) is a
+  one-line edit.
 
 The axis/comm metadata comes from ``ops.collective.COLLECTIVE_OPS`` --
 op-level tags, so new collective ops opt into all of these checks by adding
@@ -137,6 +143,7 @@ class DistributedPass(AnalysisPass):
                 self._check_axes(ctx, diags)
             self._check_sharding(ctx, diags)
             self._check_regather(ctx, diags)
+            self._check_elastic(ctx, diags)
         return diags
 
     # ------------------------------------------------------------ PT041 --
@@ -298,6 +305,45 @@ class DistributedPass(AnalysisPass):
                                      f"executor reject the feed -- pad the "
                                      f"dim or change the mesh",
                             block_idx=b.idx, var=n))
+
+    # ------------------------------------------------------------ PT047 --
+    def _check_elastic(self, ctx, diags):
+        """Elastic-incompatibility lint: a data var whose batch dim is
+        HARDCODED to a multiple of the current data-parallel degree works
+        today but pins the world size -- the first elastic resize to a
+        non-divisor (8 -> 6 after a rank loss) rejects every feed.  A
+        dynamic (-1) batch dim resizes freely, and an already-indivisible
+        batch is PT045's error, so PT047 fires exactly on the
+        works-until-the-first-kill case."""
+        ds = ctx.strategy
+        sizes = dict(ds.mesh_shape)
+        if not sizes:
+            return   # default mesh: dp = device count, unknown statically
+        for b in ctx.program.blocks:
+            for n, v in b.vars.items():
+                if not v.is_data or v.ndim < 1:
+                    continue
+                spec = spec_entries(ds.data_spec(n, v.ndim))
+                if not spec or not spec[0]:
+                    continue   # batch dim not sharded: resize-safe
+                nshards = axis_product(spec[0], sizes)
+                if nshards <= 1:
+                    continue
+                extent = v.shape[0]
+                if not isinstance(extent, int) or extent <= 0:
+                    continue   # dynamic batch: elastic-safe
+                if extent % nshards == 0:
+                    diags.append(Diagnostic(
+                        "PT047", f"data var {n!r} hardcodes batch dim "
+                                 f"{extent}, divisible by the current "
+                                 f"{spec[0]!r} degree ({nshards}) but "
+                                 f"pinned to it: an elastic resize to a "
+                                 f"world that does not divide {extent} "
+                                 f"(e.g. {nshards} -> {nshards - 1} after "
+                                 f"a rank loss) rejects every feed; "
+                                 f"declare the batch dim dynamic (-1) to "
+                                 f"resize freely",
+                        block_idx=b.idx, var=n))
 
     # ------------------------------------------------------------ PT046 --
     def _check_regather(self, ctx, diags):

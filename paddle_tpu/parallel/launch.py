@@ -28,7 +28,10 @@ def _free_port() -> int:
 def launch(nproc: int, script_argv, coordinator: str = None,
            devices_per_proc: int = None, log_dir: str = None,
            poll_interval: float = 0.5, max_restarts: int = 0,
-           restart_backoff: float = 1.0, restart_backoff_max: float = 30.0):
+           restart_backoff: float = 1.0, restart_backoff_max: float = 30.0,
+           elastic: bool = False, min_ranks: int = None,
+           healthy_reset_secs: float = 600.0, controller=None,
+           max_preempt_restarts: int = 1000):
     """Spawn ``nproc`` copies of ``script_argv``; returns exit codes.
 
     Failure handling (reference heart_beat_monitor.h:38 analog for the
@@ -46,6 +49,32 @@ def launch(nproc: int, script_argv, coordinator: str = None,
     verbatim across restarts (external peers agreed on it); the default
     localhost endpoints are refreshed to dodge TIME_WAIT.
 
+    Two restart refinements (ISSUE 11):
+
+    - an attempt whose only non-zero exits are
+      ``resilience.PREEMPTED_EXIT`` (a rank left via the resumable
+      ``Preempted`` path) is a CLEAN elastic event: it relaunches without
+      consuming the restart budget and without growing the backoff.
+      ``max_preempt_restarts`` bounds the total clean restarts (a
+      workload preempted every few seconds forever must eventually hand
+      the exit codes back instead of looping);
+    - the backoff attempt counter resets after ``healthy_reset_secs`` of
+      attempt uptime, so a failure late in a long run pays the base
+      delay, not the 30 s cap it would have inherited from incidents
+      hours ago.
+
+    ``elastic=True`` arms world-size-changing recovery: after a failed
+    attempt a shrink-vs-wait policy (``controller``, default
+    :class:`resilience.elastic.ElasticController` consuming the goodput
+    ledger and straggler verdicts) may relaunch the SURVIVING ranks at a
+    smaller world size (never below ``min_ranks``) with a re-derived
+    ``PADDLE_TRAINER_ENDPOINTS``/rank map, or grow back toward the
+    nominal ``nproc`` on a later restart.  Ranks read their current
+    world from ``PADDLE_TRAINERS_NUM`` as always; the nominal size rides
+    along as ``PADDLE_NOMINAL_TRAINERS_NUM``.  Resizes journal
+    ``elastic_decision`` events and move the ``elastic_world_size``
+    gauge / ``elastic_resizes_total{direction}`` counter.
+
     Each rank gets a DISTINCT endpoint (endpoints[0] is the coordinator),
     matching the reference's launcher contract where user code indexes
     PADDLE_TRAINER_ENDPOINTS[rank].
@@ -54,6 +83,15 @@ def launch(nproc: int, script_argv, coordinator: str = None,
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
     import random
     import time
+
+    from ..resilience.elastic import PREEMPTED_EXIT
+
+    if elastic and controller is None:
+        from ..resilience.elastic import ElasticController
+        # one "healthy interval" for both consumers: the backoff ladder
+        # reset here and the controller's transient/grow classification
+        controller = ElasticController(nproc, min_ranks=min_ranks or 1,
+                                       healthy_secs=healthy_reset_secs)
 
     # Restart DOWNTIME (kill -> respawned job) is measured, not just
     # counted: the goodput ledger needs elastic-restart seconds as a named
@@ -77,28 +115,63 @@ def launch(nproc: int, script_argv, coordinator: str = None,
                        "attempt": down["attempt"],
                        "downtime_s": round(downtime, 3)})
 
-    for attempt in range(max_restarts + 1):
-        codes = _launch_once(nproc, script_argv, coordinator,
-                             devices_per_proc, log_dir, poll_interval,
-                             attempt, spawned_cb=_respawned)
-        if all(c == 0 for c in codes) or attempt == max_restarts:
+    cur = nproc
+    budget_used = 0       # real failures only; clean preempt exits are free
+    clean_used = 0        # bounded separately by max_preempt_restarts
+    backoff_attempt = 0   # resets on clean events / healthy intervals
+    attempt = 0           # monotone, exported as PADDLE_RESTART_ATTEMPT
+    while True:
+        if elastic:
+            from ..observability.metrics import REGISTRY as _OBS
+            _OBS.gauge("elastic_world_size",
+                       "current world size of the elastic launch").set(cur)
+        t_attempt = time.perf_counter()
+        codes, terminated = _launch_once(
+            cur, script_argv, coordinator, devices_per_proc, log_dir,
+            poll_interval, attempt, spawned_cb=_respawned,
+            nominal_nproc=nproc if elastic else None)
+        runtime = time.perf_counter() - t_attempt
+        if all(c == 0 for c in codes):
+            if controller is not None:
+                controller.note_success()
             return codes
-        # Exponential backoff with jitter between restarts: an immediate
-        # relaunch into the fault that just killed the job (a recovering
-        # coordinator, a TIME_WAIT'd port, a still-propagating checkpoint)
-        # burns restart budget for nothing, and a fleet of launchers
-        # restarting in lockstep thunders the shared store.
-        #
-        # The culprit rank: prefer a positive exit code (the rank that
-        # actually failed) over the monitor's terminations (negative) and
-        # unreaped ranks (None) -- but any non-clean rank counts, matching
-        # main()'s exit-code convention.
+        # A rank that exited through the resumable Preempted path
+        # (PREEMPTED_EXIT) asked for a relaunch, it didn't fail; ranks
+        # the MONITOR terminated are collateral of whoever died first.
+        # The attempt is clean when nothing else went wrong.
         bad = [r for r, c in enumerate(codes) if c != 0]
+        culprits = [r for r in bad
+                    if codes[r] is not None and codes[r] != PREEMPTED_EXIT
+                    and r not in terminated]
+        clean = not culprits and any(codes[r] == PREEMPTED_EXIT
+                                     for r in bad)
+        if not clean:
+            budget_used += 1
+            if budget_used > max_restarts:
+                return codes
+        else:
+            clean_used += 1
+            if max_restarts <= 0 and not elastic:
+                # restarts never enabled: keep the historical contract
+                # and hand the codes back instead of resuming forever
+                return codes
+            if clean_used > max_preempt_restarts:
+                sys.stderr.write(
+                    f"[paddle_tpu.launch] {clean_used - 1} clean preempt "
+                    f"restarts exhausted max_preempt_restarts; giving "
+                    f"the exit codes back\n")
+                return codes
+        # Backoff bookkeeping: clean events and attempts that ran healthy
+        # for a while restart the ladder at the base delay -- a failure
+        # late in a long run must not start at the cap.
+        if clean or runtime >= healthy_reset_secs:
+            backoff_attempt = 0
+        backoff_attempt += 1
         culprit = next(
-            (r for r in bad if codes[r] is not None and codes[r] > 0),
-            bad[0] if bad else None)
+            (r for r in culprits if codes[r] is not None and codes[r] > 0),
+            culprits[0] if culprits else (bad[0] if bad else None))
         from ..resilience.recovery import backoff_delay
-        delay = backoff_delay(attempt + 1, restart_backoff,
+        delay = backoff_delay(backoff_attempt, restart_backoff,
                               restart_backoff_max, random)
         from ..observability import journal as _journal
         from ..observability.metrics import REGISTRY as _OBS
@@ -106,21 +179,47 @@ def launch(nproc: int, script_argv, coordinator: str = None,
                      "whole-job elastic restarts by the launcher").inc()
         _journal.emit({"event": "elastic_restart", "attempt": attempt + 1,
                        "max_restarts": max_restarts,
+                       "budget_used": budget_used, "clean": clean,
                        "failed_rank": culprit,
                        "exit_codes": list(codes),
                        "backoff_s": round(delay, 3)})
+        nxt = cur
+        if controller is not None:
+            decision = controller.decide(cur, codes, runtime,
+                                         culprits=culprits, clean=clean)
+            # the floor binds whatever controller produced the target --
+            # a custom policy must not shrink below the documented
+            # min_ranks contract
+            nxt = max(min_ranks or 1, min(nproc,
+                                          int(decision.target_nproc)))
+            if nxt != cur:
+                direction = "shrink" if nxt < cur else "grow"
+                _OBS.counter("elastic_resizes_total",
+                             "elastic world-size changes by direction",
+                             direction=direction).inc()
+                sys.stderr.write(
+                    f"[paddle_tpu.launch] elastic {direction}: "
+                    f"{cur} -> {nxt} ranks ({decision.reason})\n")
         sys.stderr.write(
-            f"[paddle_tpu.launch] attempt {attempt} failed (rank "
+            f"[paddle_tpu.launch] attempt {attempt} "
+            f"{'preempted (clean)' if clean else 'failed'} (rank "
             f"{culprit if culprit is not None else '?'}); restarting the "
-            f"job from the latest checkpoint in {delay:.1f}s "
-            f"({attempt + 1}/{max_restarts} restarts used)\n")
+            f"job from the latest checkpoint in {delay:.1f}s at "
+            f"{nxt} rank(s) ({budget_used}/{max_restarts} restarts "
+            f"used)\n")
+        cur = nxt
         down["t0"] = time.perf_counter()
         down["attempt"] = attempt + 1
         time.sleep(delay)
+        attempt += 1
 
 
 def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
-                 poll_interval, attempt, spawned_cb=None):
+                 poll_interval, attempt, spawned_cb=None,
+                 nominal_nproc=None):
+    """One attempt at ``nproc`` ranks.  Returns ``(codes, terminated)``
+    where ``terminated`` is the set of ranks the MONITOR killed (collateral
+    of another rank's death -- the restart loop must not blame them)."""
     import time
     if coordinator:
         host, port0 = coordinator.rsplit(":", 1)
@@ -146,6 +245,12 @@ def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
             "PADDLE_CURRENT_ENDPOINT": eps[rank],
             "PADDLE_RESTART_ATTEMPT": str(attempt),
         })
+        if nominal_nproc is not None:
+            # elastic mode: the CURRENT world is PADDLE_TRAINERS_NUM; the
+            # size the job was asked for rides along so workloads can
+            # adapt (e.g. re-arm a chaos fault only at full size)
+            env["PADDLE_ELASTIC"] = "1"
+            env["PADDLE_NOMINAL_TRAINERS_NUM"] = str(nominal_nproc)
         if devices_per_proc:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 f" --xla_force_host_platform_device_count="
@@ -166,6 +271,7 @@ def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
         codes = [p.poll() for p in procs]
         bad = [r for r, c in enumerate(codes) if c not in (None, 0)]
         if bad:
+            terminated = {r for r, c in enumerate(codes) if c is None}
             for r, p in enumerate(procs):
                 if codes[r] is None:
                     p.terminate()
@@ -175,6 +281,15 @@ def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait()   # reap: no zombies, returncode always set
+            # reclassify: a rank that was still running at the poll
+            # snapshot but whose final code is neither our SIGTERM/
+            # SIGKILL nor a clean/preempted exit crashed ON ITS OWN in
+            # the race window -- it must stay blamable, not be excused
+            # as monitor collateral
+            import signal as _sig
+            terminated = {r for r in terminated
+                          if procs[r].returncode in
+                          (0, -_sig.SIGTERM, -_sig.SIGKILL)}
             r = bad[0]
             tail = b""
             try:
@@ -184,12 +299,12 @@ def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
                 pass
             sys.stderr.write(
                 f"\n[paddle_tpu.launch] rank {r} died with exit code "
-                f"{codes[r]}; terminated {sum(1 for c in codes if c is None)} "
+                f"{codes[r]}; terminated {len(terminated)} "
                 f"surviving rank(s). Log tail ({logs[r]}):\n"
                 f"{tail.decode(errors='replace')}\n")
-            return [p.returncode for p in procs]
+            return [p.returncode for p in procs], terminated
         if all(c is not None for c in codes):
-            return list(codes)
+            return list(codes), set()
         time.sleep(poll_interval)
 
 
@@ -201,10 +316,23 @@ def main():
     ap.add_argument("--log_dir", default=None)
     ap.add_argument("--max_restarts", type=int, default=0,
                     help="restart the whole job up to N times on failure "
-                         "(resume from your Checkpointer)")
+                         "(resume from your Checkpointer); ranks exiting "
+                         "with resilience.PREEMPTED_EXIT (75) restart "
+                         "without consuming this budget")
     ap.add_argument("--restart_backoff", type=float, default=1.0,
                     help="base seconds between elastic restarts; doubles "
                          "per attempt with jitter, capped at 30s")
+    ap.add_argument("--elastic", action="store_true",
+                    help="allow world-size-changing restarts: a "
+                         "shrink-vs-wait policy may relaunch the "
+                         "surviving ranks at N-k (>= --min_ranks) or grow "
+                         "back toward N on a later restart")
+    ap.add_argument("--min_ranks", type=int, default=None,
+                    help="elastic floor: never shrink below this many "
+                         "ranks (default 1)")
+    ap.add_argument("--healthy_reset_secs", type=float, default=600.0,
+                    help="an attempt that ran at least this long resets "
+                         "the restart-backoff ladder to the base delay")
     ap.add_argument("script", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.script:
@@ -212,7 +340,9 @@ def main():
     codes = launch(args.nproc, args.script, args.coordinator,
                    args.devices_per_proc, log_dir=args.log_dir,
                    max_restarts=args.max_restarts,
-                   restart_backoff=args.restart_backoff)
+                   restart_backoff=args.restart_backoff,
+                   elastic=args.elastic, min_ranks=args.min_ranks,
+                   healthy_reset_secs=args.healthy_reset_secs)
     # any non-clean rank (nonzero, signal-killed => negative, unreaped =>
     # None) must fail the launch: max() would mask -11 behind a clean 0
     sys.exit(0 if all(c == 0 for c in codes) else 1)

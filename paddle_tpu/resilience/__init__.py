@@ -13,15 +13,29 @@ that proves it works:
   nonfinite-step policy ``skip|rollback|raise``, bounded backoff-with-
   jitter retry, a hung-step deadline (:class:`StepTimeout`), and
   preemption-safe emergency checkpointing (:class:`Preempted`).
+- :mod:`elastic` -- world-size-changing recovery (ISSUE 11): the
+  device-free reshard planner (:func:`elastic.plan_reshard` /
+  :func:`elastic.apply_reshard`), batch-schedule re-planning
+  (:func:`elastic.replan_batch_schedule`), the shrink-vs-wait
+  :class:`elastic.ElasticController` the launcher consults, and
+  :data:`elastic.PREEMPTED_EXIT` (exit 75 = clean resumable exit).
 - chaos CLI: ``python -m paddle_tpu.resilience`` / ``tools/chaos.py``
-  (``--selftest`` pinned by the test suite).
+  (``--selftest`` pinned by the test suite; ``--ranks N --kill K`` drives
+  the kill-K-of-N elastic scenario end to end).
 
 Everything is off-by-default-cheap: with ``PADDLE_TPU_FAULTS`` unset and a
 default-configured guardian there is no per-step file I/O, no signal
 handler, no watchdog thread, and no snapshot copy (guard-tested).
 """
+from . import elastic  # noqa: F401
 from . import faults  # noqa: F401
 from . import recovery  # noqa: F401
+from .elastic import (PREEMPTED_EXIT, ElasticController,  # noqa: F401
+                      ElasticDecision, ReshardPlan, VarPlan, apply_reshard,
+                      layout_from_metas, note_world_change,
+                      plan_for_checkpoint, plan_reshard,
+                      replan_batch_schedule, shard_regions, zero_layout,
+                      zero_shard_dim)
 from .faults import (Fault, FaultSpecError, TransientFault, active,  # noqa
                      armed, clear, install, install_from_env, parse_spec)
 from .recovery import (Preempted, StepGuardian, StepTimeout,  # noqa

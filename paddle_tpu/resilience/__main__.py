@@ -14,6 +14,20 @@ finishes the remaining steps -- the end-to-end recovery story in one
 command.  The summary counts ``fault``/``retry``/``skip``/``rollback``/
 ``preempt`` journal events observed during the run.
 
+Multi-rank elastic mode (ISSUE 11)::
+
+    python -m paddle_tpu.resilience --ranks 8 --kill 2   # kill-2-of-8
+
+drives the elastic launcher end to end: N rank processes train under
+per-step checkpoints, K of them hard-die (``kill`` fault) mid-epoch on
+every attempt at full size, the shrink-vs-wait controller relaunches the
+survivors at N-K, and -- unless ``--no-compare`` -- the resumed losses
+are checked byte-for-byte against a clean N-K-rank run restored from the
+same checkpoint step.  Runs on any backend (ranks are replicated
+simulations); ``--connect`` upgrades to a real ``jax.distributed``
+data-parallel fleet (needs a multiprocess-capable backend; the test
+suite gates that leg on the backend probe).
+
 Exit codes: 0 all steps completed, 1 incomplete run or error, 2 usage.
 """
 from __future__ import annotations
@@ -115,6 +129,179 @@ def run_chaos(steps: int = 10, faults_spec: Optional[str] = None,
     return summary
 
 
+def _rank0_record(log_dir: str, attempt: int) -> Optional[dict]:
+    """Parse rank 0's ``ELASTIC_RUN`` record of one launch attempt."""
+    name = "rank0.log" if attempt == 0 else f"rank0.attempt{attempt}.log"
+    path = os.path.join(log_dir, name)
+    try:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                if line.startswith("ELASTIC_RUN:"):
+                    return json.loads(line[len("ELASTIC_RUN:"):])
+    except (OSError, ValueError):
+        return None
+    return None
+
+
+def _final_attempt(log_dir: str) -> int:
+    best = 0
+    try:
+        for n in os.listdir(log_dir):
+            if n.startswith("rank0.attempt") and n.endswith(".log"):
+                try:
+                    best = max(best, int(n[len("rank0.attempt"):-len(".log")]))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return best
+
+
+def run_elastic_chaos(ranks: int = 8, kill: int = 2, steps: int = 12,
+                      kill_step: int = 3, seed: int = 0, dim: int = 8,
+                      batch: int = 24, ckpt_dir: Optional[str] = None,
+                      log_dir: Optional[str] = None, connect: bool = False,
+                      max_restarts: int = 5, compare: bool = True,
+                      backoff: float = 0.05,
+                      step_secs: float = 0.12) -> dict:
+    """Kill-K-of-N end to end; returns a JSON-able summary.
+
+    Ranks ``N-K .. N-1`` hard-die (SIGKILL via the ``kill`` fault) at
+    ``kill_step`` on EVERY attempt whose world still includes them, so
+    the fleet genuinely cannot hold any size above N-K: the launcher's
+    controller retries once, then shrinks the surviving ranks down to
+    N-K, which completes.  With ``compare`` the resumed attempt's losses
+    are verified byte-identical against a clean N-K run restored from
+    the same checkpoint step (consistency modulo the re-planned batch
+    schedule -- the documented elastic contract)."""
+    if not (0 < kill < ranks):
+        raise ValueError(f"need 0 < kill < ranks, got kill={kill} "
+                         f"ranks={ranks}")
+    import tempfile
+
+    from ..observability import journal as _journal
+    from ..observability.metrics import REGISTRY as _OBS
+    from ..parallel.launch import launch
+
+    base = ckpt_dir or tempfile.mkdtemp(prefix="paddle_tpu_elastic_")
+    ckpt = os.path.join(base, "ck")
+    log_dir = log_dir or os.path.join(base, "logs")
+    kill_ranks = ",".join(str(r) for r in range(ranks - kill, ranks))
+    worker = ["-m", "paddle_tpu.resilience.elastic_worker",
+              "--steps", str(steps), "--dim", str(dim),
+              "--batch", str(batch), "--seed", str(seed),
+              "--ckpt", ckpt, "--kill-ranks", kill_ranks,
+              "--kill-step", str(kill_step),
+              "--step-secs", str(step_secs)]
+    if connect:
+        worker.append("--connect")
+
+    def _counter(name, **labels):
+        fam = _OBS.get(name)
+        if fam is None:
+            return 0.0
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        child = fam.children.get(key)
+        return child.value if child is not None else 0.0
+
+    lost0 = _counter("lost_seconds_total", cause="elastic_restart")
+    shrinks0 = _counter("elastic_resizes_total", direction="shrink")
+    t0 = time.time()
+    codes = launch(ranks, worker, log_dir=log_dir, poll_interval=0.1,
+                   max_restarts=max_restarts, restart_backoff=backoff,
+                   elastic=True, min_ranks=ranks - kill)
+    summary = {"ranks": ranks, "kill": kill, "steps": steps,
+               "kill_step": kill_step, "connect": connect,
+               "exit_codes": list(codes), "ok": all(c == 0 for c in codes),
+               "final_world": None, "restored_step": None,
+               "resumed_start": None, "byte_consistent": None,
+               "downtime_s": round(_counter("lost_seconds_total",
+                                            cause="elastic_restart")
+                                   - lost0, 3),
+               "shrinks": _counter("elastic_resizes_total",
+                                   direction="shrink") - shrinks0,
+               "elastic_world_size": None,
+               "log_dir": log_dir, "ckpt_dir": ckpt}
+    g = _OBS.get("elastic_world_size")
+    if g is not None:
+        child = g.children.get(())
+        summary["elastic_world_size"] = child.value if child else None
+    evs = [e for e in _journal.recent() if e.get("ts", 0) >= t0]
+    summary["events"] = {k: sum(1 for e in evs if e.get("event") == k)
+                         for k in ("elastic_restart", "elastic_decision",
+                                   "elastic_restart_downtime")}
+    decisions = [e for e in evs if e.get("event") == "elastic_decision"]
+    summary["decisions"] = [{"action": e["action"],
+                             "target_nproc": e["target_nproc"]}
+                            for e in decisions]
+    if not summary["ok"]:
+        return summary
+    rec = _rank0_record(log_dir, _final_attempt(log_dir))
+    if rec is None:
+        summary["ok"] = False
+        summary["error"] = "no ELASTIC_RUN record in the final attempt log"
+        return summary
+    summary["final_world"] = rec["world"]
+    summary["restored_step"] = rec["restored"]
+    summary["resumed_start"] = rec["start"]
+    summary["replanned"] = rec.get("replan") is not None
+    if not rec["losses_hex"]:
+        # the failure frontier outran the workload: nothing was left to
+        # resume, so "byte-consistent resume" would be vacuous
+        summary["ok"] = False
+        summary["error"] = ("resumed attempt had no steps left to run; "
+                            "raise --steps or lower --kill-step")
+        return summary
+    if compare and rec["restored"] < 0:
+        # resuming from scratch proves nothing about the restore path --
+        # an OK verdict here would be the acceptance claim unchecked
+        summary["ok"] = False
+        summary["error"] = ("final attempt restored no checkpoint; the "
+                            "kills landed before the first save (raise "
+                            "--kill-step)")
+        return summary
+    if compare and rec["restored"] >= 0:
+        # the flagship check: a CLEAN N-K-rank run restored from the same
+        # step must produce byte-identical losses (same re-planned batch
+        # schedule, same state bytes, no faults)
+        cmp_worker = ["-m", "paddle_tpu.resilience.elastic_worker",
+                      "--steps", str(steps), "--dim", str(dim),
+                      "--batch", str(batch), "--seed", str(seed),
+                      "--ckpt", ckpt, "--restore-step",
+                      str(rec["restored"]), "--no-save"]
+        if connect:
+            cmp_worker.append("--connect")
+        cmp_logs = log_dir + "_compare"
+        cmp_codes = launch(rec["world"], cmp_worker, log_dir=cmp_logs,
+                           poll_interval=0.2)
+        cmp_rec = _rank0_record(cmp_logs, 0)
+        summary["compare_exit_codes"] = list(cmp_codes)
+        summary["byte_consistent"] = (
+            all(c == 0 for c in cmp_codes) and cmp_rec is not None and
+            cmp_rec["losses_hex"] == rec["losses_hex"] and
+            bool(rec["losses_hex"]))
+        summary["ok"] = summary["ok"] and bool(summary["byte_consistent"])
+    return summary
+
+
+def _fmt_elastic(summary: dict, out=None):
+    out = out or sys.stdout
+    print(f"elastic chaos: kill {summary['kill']} of {summary['ranks']} "
+          f"ranks at step {summary['kill_step']} -> "
+          f"{'OK' if summary['ok'] else 'FAILED'}", file=out)
+    print(f"  final world: {summary['final_world']} "
+          f"(restored step {summary['restored_step']}, resumed at "
+          f"{summary['resumed_start']})", file=out)
+    print(f"  restarts: {summary['events']['elastic_restart']} "
+          f"(decisions: {[d['action'] for d in summary['decisions']]}); "
+          f"downtime {summary['downtime_s']}s in "
+          f"lost_seconds_total{{cause=elastic_restart}}", file=out)
+    if summary["byte_consistent"] is not None:
+        print(f"  byte-consistent with a clean {summary['final_world']}"
+              f"-rank run from step {summary['restored_step']}: "
+              f"{summary['byte_consistent']}", file=out)
+
+
 def _fmt_text(summary: dict, out=None):
     out = out or sys.stdout
     print(f"chaos run: {summary['steps_completed']}/{summary['steps']} "
@@ -138,9 +325,96 @@ def _fmt_text(summary: dict, out=None):
         print(f"  final loss: {summary['final_loss']:.6g}", file=out)
 
 
+def _selftest_elastic():
+    """Hermetic elastic-subsystem checks: plan/apply round trip, uneven
+    degradation, batch re-planning, controller policy.  Device-free."""
+    import warnings
+
+    import numpy as np
+
+    from . import elastic as _elastic
+
+    # kill fault spec grammar
+    from . import faults as _faults
+    ks = _faults.parse_spec("kill:step=5;kill@fetch:step=3:value=75")
+    assert [f.kind for f in ks] == ["kill", "kill"]
+    assert ks[0].site == "dispatch" and ks[1].value == 75.0
+
+    # reshard plan: 8 -> 6 -> 8 round-trips byte-identically
+    rs = np.random.RandomState(0)
+    state = {"w": rs.rand(24, 8).astype("float32"),
+             "moment": rs.rand(24, 8).astype("float32"),
+             "lr": np.asarray([0.1], "float32")}
+    shapes = {n: list(v.shape) for n, v in state.items()}
+    lay8 = _elastic.zero_layout(shapes, 8, shard_vars=lambda n: n != "lr")
+    metas, chunks = {}, {}
+    for n, v in state.items():
+        entries = []
+        for i, (rank, region) in enumerate(lay8[n]["regions"]):
+            f = f"{n}.r{rank}c{i}.npy"
+            chunks[f] = v[tuple(slice(a, b) for a, b in region)].copy()
+            entries.append({"file": f, "index": region})
+        metas[n] = {"name": n, "dtype": str(v.dtype),
+                    "shape": list(v.shape), "chunks": entries}
+    lay6 = _elastic.zero_layout(shapes, 6, shard_vars=lambda n: n != "lr")
+    p86 = _elastic.plan_reshard(metas, lay6, src_world=8, dst_world=6,
+                                journal=False)
+    assert p86.actions() == {"redistribute": 2, "keep": 1}, p86.actions()
+    m6, c6 = _elastic.apply_reshard(p86, chunks, metas)
+    p68 = _elastic.plan_reshard(m6, lay8, src_world=6, dst_world=8,
+                                journal=False)
+    m8, c8 = _elastic.apply_reshard(p68, c6, m6)
+    for n, v in state.items():
+        full = np.zeros_like(v)
+        for ch in m8[n]["chunks"]:
+            sl = tuple(slice(a, b) for a, b in ch["index"])
+            full[sl] = c8[ch["file"]]
+        assert full.tobytes() == v.tobytes(), f"{n} did not round-trip"
+
+    # uneven divisibility degrades to replicate (warns, never crashes)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        lay5 = _elastic.zero_layout({"odd": [9, 3]}, 5)
+    assert lay5["odd"]["placement"] == "replicated" and \
+        lay5["odd"]["fallback"]
+    assert any("replicated" in str(x.message) for x in w)
+
+    # batch-schedule re-planning
+    r = _elastic.replan_batch_schedule({"epoch": 2, "batch": 10}, 8, 6,
+                                       global_batch=24, journal=False)
+    assert r["skip_batches"] == 10 and r["epoch"] == 2
+    assert r["rank_slices"] == [[0, 4], [4, 8], [8, 12], [12, 16],
+                                [16, 20], [20, 24]]
+    r7 = _elastic.replan_batch_schedule({"batch": 4}, 8, 7,
+                                        global_batch=24, journal=False)
+    assert r7["uneven"] and [b - a for a, b in r7["rank_slices"]] == \
+        [4, 4, 4, 3, 3, 3, 3]
+    rp = _elastic.replan_batch_schedule({"batch": 10}, 8, 6,
+                                        global_batch=24, mode="per_rank",
+                                        journal=False)
+    # 240 samples consumed, new global batch 18: floor -> 13 * 18 = 234,
+    # 6 samples re-trained rather than dropped
+    assert rp["skip_batches"] == 13 and rp["retrained_samples"] == 6
+
+    # controller policy: retry, then shrink on the repeat; clean -> grow
+    ctl = _elastic.ElasticController(8, min_ranks=6)
+    d1 = ctl.decide(8, [0, 0, 0, 0, 0, 0, -9, -9], 1.0,
+                    culprits=[6, 7], clean=False, journal=False)
+    assert d1.action == "retry" and d1.target_nproc == 8, d1
+    d2 = ctl.decide(8, [0, 0, 0, 0, 0, 0, -9, -9], 1.0,
+                    culprits=[6, 7], clean=False, journal=False)
+    assert d2.action == "shrink" and d2.target_nproc == 6, d2
+    d3 = ctl.decide(6, [0] * 5 + [75], 1.0, clean=True, journal=False)
+    assert d3.action == "grow" and d3.target_nproc == 8, d3
+    ctl2 = _elastic.ElasticController(4, min_ranks=2)
+    d4 = ctl2.decide(4, [0, 0, 0, 3], 9999.0, clean=False, journal=False)
+    assert d4.action == "retry", d4   # healthy interval: transient
+
+
 def selftest() -> int:
     """Hermetic end-to-end self-check of the fault injector + guardian +
-    preemption-safe checkpointing; pinned by the test suite (smoke tier)."""
+    preemption-safe checkpointing + elastic machinery; pinned by the test
+    suite (smoke tier)."""
     import tempfile
 
     from . import faults as _faults
@@ -188,6 +462,10 @@ def selftest() -> int:
             _faults.clear()
             _recovery.clear_preemption()
     assert not _faults.armed()
+
+    # 3. elastic machinery (reshard plan round trip, batch re-planning,
+    # shrink-vs-wait policy) -- device-free, no subprocesses
+    _selftest_elastic()
     print("chaos selftest: OK")
     return 0
 
@@ -216,10 +494,40 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--no-resume", action="store_true",
                     help="do not resume after a (simulated) preemption")
+    ap.add_argument("--ranks", type=int, default=0,
+                    help="multi-rank elastic mode: launch this many rank "
+                         "processes under the elastic launcher")
+    ap.add_argument("--kill", type=int, default=2,
+                    help="elastic mode: hard-kill this many ranks "
+                         "mid-epoch (SIGKILL at --kill-step)")
+    ap.add_argument("--kill-step", type=int, default=3)
+    ap.add_argument("--connect", action="store_true",
+                    help="elastic mode: real jax.distributed data "
+                         "parallelism (needs a multiprocess backend)")
+    ap.add_argument("--no-compare", action="store_true",
+                    help="elastic mode: skip the byte-consistency "
+                         "comparison run")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.ranks:
+        try:
+            summary = run_elastic_chaos(
+                ranks=args.ranks, kill=args.kill, steps=args.steps,
+                kill_step=args.kill_step, seed=args.seed, dim=args.dim,
+                batch=args.batch, ckpt_dir=args.ckpt,
+                connect=args.connect, compare=not args.no_compare)
+        except Exception as e:  # noqa: BLE001 -- CLI boundary
+            print(f"elastic chaos run failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.format == "json":
+            print(json.dumps(summary, indent=2, sort_keys=True,
+                             default=str))
+        else:
+            _fmt_elastic(summary)
+        return 0 if summary["ok"] else 1
     try:
         summary = run_chaos(
             steps=args.steps, faults_spec=args.faults, policy=args.policy,

@@ -1,0 +1,719 @@
+"""Elastic world-size-changing training: survive rank loss at N±k.
+
+The reference Fluid stack treats worker loss as fatal-until-identical-
+relaunch.  At fleet scale the run that *heals itself at a smaller world
+size* is the difference between goodput and a dead job -- and every
+prerequisite already exists in-repo: reshard-on-load checkpoints (io.py
+chunked format), preemption-safe exact resume + elastic restarts with
+backoff (parallel/launch.py), and the goodput ledger + straggler verdicts
+(observability).  This module closes the loop with three device-free
+pieces the launcher and checkpointer consume:
+
+- **Reshard planning** (:func:`plan_reshard`): given the chunk layout a
+  checkpoint was saved under (its manifests) and the layout a new world
+  size wants (:func:`zero_layout` re-derives the ZeRO shard divisors the
+  way ``CompiledProgram.state_sharding`` does -- first dim divisible by
+  the new dp, else *replicate with a warning, never a crash*), emit a
+  per-var plan of gather/slice/redistribute steps.  The decomposition
+  into per-destination-region chunk reads follows the spec-to-spec array
+  redistribution framing of arXiv:2112.01075: each step is the minimal
+  set of source reads covering one destination region.  Plans are
+  journaled (``reshard_plan``) and pure metadata -- unit-testable without
+  devices; :func:`apply_reshard` executes one on host numpy chunks (the
+  N->M->N round-trip test proves byte-identical state).
+- **Batch-schedule re-planning** (:func:`replan_batch_schedule`): recompute
+  the exact-resume dataset position (``trainstate.json``'s epoch/batch)
+  for the new world so no sample is dropped or double-trained beyond the
+  documented schedule change.  ``mode="global"`` (the launcher's default
+  contract: the dataset yields *global* batches and each rank feeds its
+  slice) keeps ``skip_batches`` as saved and re-derives the per-rank
+  slice table -- uneven division spreads the remainder over the first
+  ranks instead of crashing.  ``mode="per_rank"`` (per-rank batch size
+  fixed, global batch scales with the world) recomputes ``skip_batches``
+  against the new global batch, rounding DOWN: the sub-batch remainder is
+  re-trained (reported as ``retrained_samples``) rather than silently
+  dropped.
+- **Shrink-vs-wait policy** (:class:`ElasticController`): the launcher
+  asks it after every failed attempt.  Repeated failures at the same
+  world size -- or a culprit rank the straggler detector has verdicts
+  against -- bias toward shrinking (down to ``min_ranks``); a healthy
+  fleet with a transient failure biases toward a same-size retry; a
+  clean elastic event (every non-zero exit is :data:`PREEMPTED_EXIT`) or
+  a failure after a long healthy interval while running below nominal N
+  biases toward growing back.  Every verdict is journaled as an
+  ``elastic_decision`` event with the inputs that produced it.
+
+Gauges/counters (set by the launcher): ``elastic_world_size``,
+``elastic_resizes_total{direction}``; downtime keeps flowing into
+``lost_seconds_total{cause=elastic_restart}`` as before.
+
+Zero-overhead contract: nothing here runs per-step.  The planner runs
+only on a restore whose recorded world differs from the current one, the
+controller only between launch attempts, and with elastic mode off the
+launcher/executor hot paths are unchanged (guard-tested).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Sequence
+
+#: exit code marking a rank that left via the resumable ``Preempted`` path
+#: (EX_TEMPFAIL).  The launcher treats an attempt whose only non-zero
+#: exits are this code as a CLEAN elastic event: it relaunches without
+#: consuming the restart budget.  Training scripts opt in with::
+#:
+#:     except resilience.Preempted:
+#:         sys.exit(resilience.PREEMPTED_EXIT)
+PREEMPTED_EXIT = 75
+
+Region = List[List[int]]   # [[start, stop], ...] per dim
+
+
+# ---------------------------------------------------------------- layouts --
+
+def zero_shard_dim(shape: Sequence[int], ndp: int) -> Optional[int]:
+    """The dim ZeRO-style sharding would split over ``ndp``, or None when
+    no dim divides (the replicate fallback) -- mirrors
+    ``CompiledProgram.state_sharding``'s divisor rule so a plan derived
+    here matches what the executor will actually compile."""
+    if ndp <= 1:
+        return None
+    for dim, s in enumerate(shape):
+        if isinstance(s, int) and s > 0 and s % ndp == 0:
+            return dim
+    return None
+
+
+def shard_regions(shape: Sequence[int], nshards: int,
+                  dim: Optional[int]) -> List[Region]:
+    """The per-shard index regions of ``shape`` split ``nshards`` ways on
+    ``dim`` (``dim=None`` -> one full region, replicated).  The dim must
+    divide evenly -- a silent remainder would be rows no shard covers;
+    :func:`zero_shard_dim` picks only divisible dims."""
+    full = [[0, int(s)] for s in shape]
+    if dim is None or nshards <= 1:
+        return [full]
+    if int(shape[dim]) % nshards:
+        raise ValueError(
+            f"dim {dim} (={shape[dim]}) is not divisible by {nshards} "
+            f"shards; the tail would belong to no shard (use "
+            f"zero_shard_dim to pick a divisible dim, or replicate)")
+    per = int(shape[dim]) // nshards
+    out = []
+    for r in range(nshards):
+        region = [list(x) for x in full]
+        region[dim] = [r * per, (r + 1) * per]
+        out.append(region)
+    return out
+
+
+def zero_layout(shapes: Dict[str, Sequence[int]], world: int,
+                shard_vars: Optional[Callable[[str], bool]] = None,
+                warn: bool = True) -> Dict[str, dict]:
+    """Device-free target layout for ``world`` data-parallel shards.
+
+    ``shapes`` maps var name -> global shape; ``shard_vars(name)`` says
+    whether the var is ZeRO-shardable (optimizer state -- and params under
+    ``reduce_params``); None means shard everything it can.  A shardable
+    var no dim of which divides ``world`` DEGRADES TO REPLICATE with a
+    one-time warning (never a crash) -- the same fallback the compile
+    path takes, so restore and compile agree.  Returns per var::
+
+        {"placement": "sharded"|"replicated", "dim": int|None,
+         "regions": [(rank, region), ...], "fallback": bool}
+    """
+    layout: Dict[str, dict] = {}
+    for name, shape in shapes.items():
+        shardable = shard_vars is None or shard_vars(name)
+        dim = zero_shard_dim(shape, world) if shardable else None
+        fallback = bool(shardable and dim is None and world > 1 and
+                        any(isinstance(s, int) and s > world for s in shape))
+        if fallback and warn:
+            import warnings
+            warnings.warn(
+                f"paddle_tpu.elastic: resharding to world={world} keeps "
+                f"{name!r} replicated: no dim of shape {tuple(shape)} "
+                f"divides {world} (pad the dim or pick a divisible world "
+                f"for the full ZeRO memory win)")
+        regions = shard_regions(shape, world, dim)
+        if dim is None:
+            entries = [(0, regions[0])]
+        else:
+            entries = list(enumerate(regions))
+        layout[name] = {"placement": "sharded" if dim is not None
+                        else "replicated",
+                        "dim": dim, "regions": entries,
+                        "fallback": fallback}
+    return layout
+
+
+def layout_from_metas(metas: Dict[str, dict]) -> Dict[str, dict]:
+    """Recover the layout a checkpoint was saved under from its (merged)
+    manifest metas -- distinct chunk regions, in rank order."""
+    layout = {}
+    for name, m in metas.items():
+        seen, regions = set(), []
+        for ch in m["chunks"]:
+            key = tuple(map(tuple, ch["index"]))
+            if key not in seen:
+                seen.add(key)
+                regions.append([list(x) for x in ch["index"]])
+        sharded = len(regions) > 1
+        dim = None
+        if sharded:
+            for d in range(len(m["shape"])):
+                if len({tuple(r[d]) for r in regions}) > 1:
+                    dim = d
+                    break
+        layout[name] = {"placement": "sharded" if sharded else "replicated",
+                        "dim": dim,
+                        "regions": list(enumerate(regions)) if sharded
+                        else [(0, regions[0])] if regions else [],
+                        "fallback": False}
+    return layout
+
+
+# ------------------------------------------------------------------ plans --
+
+@dataclasses.dataclass
+class VarPlan:
+    """Reshard plan for one variable.  ``action`` classifies the minimal
+    redistribution (arXiv:2112.01075 framing):
+
+    - ``keep``: destination regions == source chunk regions (local reuse)
+    - ``slice``: replicated source -> sharded destination (local slices,
+      no cross-rank reads)
+    - ``gather``: sharded source -> replicated destination (the
+      all-gather analog; also the uneven-divisibility fallback)
+    - ``redistribute``: sharded -> sharded with different boundaries
+      (gather+slice per destination region)
+
+    ``steps`` holds one entry per destination region:
+    ``{"rank", "region", "reads": [{"file", "src", "dst"}, ...]}`` where
+    ``src``/``dst`` are [[start, stop], ...] windows in chunk-local and
+    destination-local coordinates."""
+
+    name: str
+    action: str
+    shape: List[int]
+    dtype: str
+    src_regions: int
+    dst_regions: int
+    bytes_read: int
+    bytes_out: int
+    fallback: bool
+    steps: List[dict]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        # the per-read windows are for apply_reshard / debugging; the
+        # journaled form stays summary-sized for big models
+        d["steps"] = len(self.steps)
+        return d
+
+
+@dataclasses.dataclass
+class ReshardPlan:
+    """Per-var reshard plans for one world-size (or spec) change."""
+
+    src_world: Optional[int]
+    dst_world: Optional[int]
+    vars: List[VarPlan]
+
+    def actions(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for v in self.vars:
+            out[v.action] = out.get(v.action, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {"src_world": self.src_world, "dst_world": self.dst_world,
+                "actions": self.actions(),
+                "bytes_read": sum(v.bytes_read for v in self.vars),
+                "bytes_out": sum(v.bytes_out for v in self.vars),
+                "vars": [v.to_dict() for v in self.vars]}
+
+    def summary(self) -> str:
+        acts = ", ".join(f"{n} {a}" for a, n in sorted(self.actions().items()))
+        return (f"reshard {self.src_world}->{self.dst_world}: "
+                f"{len(self.vars)} var(s) ({acts or 'nothing to do'})")
+
+
+def _dtype_bytes(dtype: str) -> int:
+    if dtype == "bfloat16":
+        return 2
+    import numpy as np
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 4
+
+
+def _nelem(region: Region) -> int:
+    n = 1
+    for a, b in region:
+        n *= max(0, b - a)
+    return n
+
+
+def _reads_for(region: Region, chunks: List[dict]) -> List[dict]:
+    """The minimal chunk reads covering ``region``: for each chunk,
+    the (chunk-local, dest-local) window of its intersection."""
+    reads = []
+    seen = set()
+    for ch in chunks:
+        if ch["file"] in seen:
+            continue
+        cidx = ch["index"]
+        inter = [[max(a, ca), min(b, cb)]
+                 for (a, b), (ca, cb) in zip(region, cidx)]
+        if any(lo >= hi for lo, hi in inter):
+            continue
+        seen.add(ch["file"])
+        reads.append({
+            "file": ch["file"],
+            "src": [[lo - ca, hi - ca]
+                    for (lo, hi), (ca, _) in zip(inter, cidx)],
+            "dst": [[lo - a, hi - a]
+                    for (lo, hi), (a, _) in zip(inter, region)]})
+    return reads
+
+
+def plan_reshard(metas: Dict[str, dict], target: Dict[str, dict],
+                 src_world: Optional[int] = None,
+                 dst_world: Optional[int] = None,
+                 journal: bool = True) -> ReshardPlan:
+    """Plan the redistribution from a checkpoint's chunk layout (``metas``,
+    the merged manifest entries ``io._read_manifests`` returns) to a
+    ``target`` layout (:func:`zero_layout` / :func:`layout_from_metas`
+    shape).  Pure metadata: no file, device or collective is touched; the
+    plan says exactly which chunk windows each destination region reads.
+
+    Vars present in ``metas`` but absent from ``target`` are skipped
+    (e.g. the target program dropped an accumulator); the reverse raises,
+    because a destination without source bytes cannot be restored."""
+    vars_: List[VarPlan] = []
+    for name in sorted(target):
+        if name not in metas:
+            raise KeyError(
+                f"reshard target names var {name!r} but the checkpoint "
+                f"manifest has no chunks for it")
+    for name in sorted(metas):
+        tgt = target.get(name)
+        if tgt is None:
+            continue
+        m = metas[name]
+        shape = list(m["shape"])
+        src_keys = {tuple(map(tuple, ch["index"])) for ch in m["chunks"]}
+        dst_keys = {tuple(map(tuple, r)) for _, r in tgt["regions"]}
+        src_sharded = len(src_keys) > 1
+        dst_sharded = len(dst_keys) > 1
+        if dst_keys == src_keys:
+            action = "keep"
+        elif src_sharded and not dst_sharded:
+            action = "gather"
+        elif not src_sharded and dst_sharded:
+            action = "slice"
+        else:
+            action = "redistribute"
+        isz = _dtype_bytes(m["dtype"])
+        steps, bytes_read, bytes_out = [], 0, 0
+        for rank, region in tgt["regions"]:
+            reads = _reads_for(region, m["chunks"])
+            # chunk regions of one var tile the array exactly (io.py's
+            # save contract), so a plain element count detects any gap
+            covered = sum(_nelem(r["dst"]) for r in reads)
+            if covered < _nelem(region):
+                raise ValueError(
+                    f"checkpoint chunks for {name!r} cover only {covered} "
+                    f"of {_nelem(region)} elements of destination region "
+                    f"{region}; a rank's manifest is missing")
+            steps.append({"rank": rank, "region": region, "reads": reads})
+            bytes_read += sum(_nelem(r["src"]) for r in reads) * isz
+            bytes_out += _nelem(region) * isz
+        vars_.append(VarPlan(
+            name=name, action=action, shape=shape, dtype=m["dtype"],
+            src_regions=len(src_keys), dst_regions=len(dst_keys),
+            bytes_read=bytes_read, bytes_out=bytes_out,
+            fallback=bool(tgt.get("fallback")), steps=steps))
+    plan = ReshardPlan(src_world=src_world, dst_world=dst_world, vars=vars_)
+    if journal:
+        from ..observability import journal as _journal
+        doc = plan.to_dict()
+        _journal.emit({"event": "reshard_plan", "src_world": src_world,
+                       "dst_world": dst_world, "actions": doc["actions"],
+                       "bytes_read": doc["bytes_read"],
+                       "bytes_out": doc["bytes_out"],
+                       "vars": [{"name": v.name, "action": v.action,
+                                 "src_regions": v.src_regions,
+                                 "dst_regions": v.dst_regions,
+                                 "fallback": v.fallback}
+                                for v in vars_]})
+    return plan
+
+
+def apply_reshard(plan: ReshardPlan, chunks: Dict[str, "object"],
+                  metas: Dict[str, dict]):
+    """Execute a plan on host numpy chunks (device-free -- the unit-test /
+    round-trip door; the live restore path goes through ``io.load_vars``
+    which stitches directly against the target jax sharding).
+
+    ``chunks`` maps chunk file name -> array.  Returns ``(new_metas,
+    new_chunks)`` in the same shape, chunk files named
+    ``<var>.r<rank>c<i>.npy``-style, so plans chain: plan(8->6) applied,
+    then plan(6->8) applied, equals the original 8-way chunks."""
+    import numpy as np
+    new_metas: Dict[str, dict] = {}
+    new_chunks: Dict[str, object] = {}
+    for vp in plan.vars:
+        m = metas[vp.name]
+        base = vp.name.replace("/", "__")
+        entries = []
+        dtype = np.asarray(chunks[m["chunks"][0]["file"]]).dtype
+        for i, step in enumerate(vp.steps):
+            region = step["region"]
+            out = np.empty([b - a for a, b in region], dtype=dtype)
+            for r in step["reads"]:
+                src = np.asarray(chunks[r["file"]])
+                src_sl = tuple(slice(a, b) for a, b in r["src"])
+                dst_sl = tuple(slice(a, b) for a, b in r["dst"])
+                out[dst_sl] = src[src_sl]
+            fname = (f"{base}.npy" if len(vp.steps) == 1 and
+                     vp.action in ("keep", "gather") and
+                     _nelem(region) == _nelem([[0, s] for s in vp.shape])
+                     else f"{base}.r{step['rank']}c{i}.npy")
+            new_chunks[fname] = out
+            entries.append({"file": fname, "index": region})
+        new_metas[vp.name] = {"name": vp.name, "dtype": vp.dtype,
+                              "shape": list(vp.shape), "chunks": entries}
+    return new_metas, new_chunks
+
+
+def plan_for_checkpoint(dirname: str, world: int,
+                        shard_vars: Optional[Callable[[str], bool]] = None,
+                        src_world: Optional[int] = None,
+                        journal: bool = True) -> ReshardPlan:
+    """Read a checkpoint's manifests and plan its redistribution to
+    ``world`` data-parallel shards under the ZeRO divisor rule.  This is
+    the restore-path hook ``Checkpointer.restore`` fires when the
+    recorded world differs from the current one -- also usable offline::
+
+        python -m paddle_tpu.resilience.elastic --plan ckpts/ckpt-120 \\
+            --world 6
+    """
+    from .. import io as _io
+    metas = _io._read_manifests(dirname, None)
+    shapes = {n: m["shape"] for n, m in metas.items()}
+    target = zero_layout(shapes, world, shard_vars=shard_vars)
+    # a var saved sharded must still reach every destination byte; metas
+    # carry the chunk regions, so planning is pure index arithmetic
+    return plan_reshard(metas, target, src_world=src_world,
+                        dst_world=world, journal=journal)
+
+
+# --------------------------------------------------------- batch schedule --
+
+def replan_batch_schedule(train_state: Optional[dict], old_world: int,
+                          new_world: int, global_batch: Optional[int] = None,
+                          mode: str = "global",
+                          journal: bool = True) -> dict:
+    """Recompute the exact-resume dataset position for a new world size.
+
+    ``train_state`` is the checkpoint's ``trainstate.json`` (may be None /
+    missing keys: a pre-elastic checkpoint resumes at epoch 0, batch 0).
+
+    - ``mode="global"`` (default): the dataset yields GLOBAL batches and
+      each rank feeds its per-rank slice (``parallel.env.shard_batch``).
+      Batches consumed is world-size independent, so ``skip_batches``
+      carries over unchanged; what changes is the slice table -- returned
+      as ``rank_slices`` when ``global_batch`` is given, spreading an
+      uneven remainder over the first ``global_batch % new_world`` ranks
+      (never a crash).  No sample is dropped or double-trained.
+    - ``mode="per_rank"``: each rank keeps its fixed per-rank batch
+      (``global_batch`` here = OLD global batch = per_rank * old_world),
+      so the global batch scales with the world and the consumed-sample
+      offset must be re-expressed in new-global-batch units.  Rounds
+      DOWN: up to one new global batch of samples is re-trained
+      (``retrained_samples``, 0 when the offset divides) -- re-training a
+      sliver beats silently dropping it.
+
+    The decision is journaled as a ``batch_replan`` event.
+    """
+    if mode not in ("global", "per_rank"):
+        raise ValueError(f"mode must be 'global' or 'per_rank', got {mode!r}")
+    if old_world < 1 or new_world < 1:
+        raise ValueError("world sizes must be >= 1")
+    ts = dict(train_state or {})
+    epoch = int(ts.get("epoch", 0))
+    batch = int(ts.get("batch", 0))
+    out = {"epoch": epoch, "skip_batches": batch, "mode": mode,
+           "old_world": old_world, "new_world": new_world,
+           "retrained_samples": 0, "dropped_samples": 0}
+    if mode == "global":
+        if global_batch is not None:
+            per, extra = divmod(int(global_batch), new_world)
+            slices, start = [], 0
+            for r in range(new_world):
+                n = per + (1 if r < extra else 0)
+                slices.append([start, start + n])
+                start += n
+            out["rank_slices"] = slices
+            out["uneven"] = extra != 0
+    else:
+        if global_batch is None:
+            raise ValueError("mode='per_rank' needs global_batch (the OLD "
+                             "global batch size)")
+        per_rank = int(global_batch) // old_world
+        if per_rank * old_world != int(global_batch):
+            raise ValueError(
+                f"global_batch {global_batch} is not divisible by the old "
+                f"world {old_world}; per-rank batch is ill-defined")
+        samples = batch * int(global_batch)
+        new_global = per_rank * new_world
+        out["skip_batches"] = samples // new_global
+        out["retrained_samples"] = samples - out["skip_batches"] * new_global
+        out["global_batch"] = new_global
+    if journal:
+        from ..observability import journal as _journal
+        _journal.emit({"event": "batch_replan", **{
+            k: v for k, v in out.items() if k != "rank_slices"}})
+    return out
+
+
+# -------------------------------------------------------------- controller --
+
+#: decision actions, in escalation order
+DECISIONS = ("retry", "shrink", "grow")
+
+
+@dataclasses.dataclass
+class ElasticDecision:
+    """One shrink-vs-wait verdict: relaunch at ``target_nproc`` ranks
+    because ``reason``; ``inputs`` carries the evidence (exit codes,
+    consecutive-failure counts, straggler verdicts, goodput losses)."""
+
+    action: str
+    target_nproc: int
+    reason: str
+    inputs: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ElasticController:
+    """Shrink-vs-wait policy consuming the PR-9 telemetry.
+
+    Called by the launcher after every failed attempt with the attempt's
+    exit codes and runtime.  The policy:
+
+    - a CLEAN elastic event (every non-zero exit is
+      :data:`PREEMPTED_EXIT`) or a failure after ``healthy_secs`` of
+      uptime is evidence the world is viable: retry at the same size --
+      or GROW back toward nominal N when running shrunken;
+    - ``repeat_threshold`` consecutive failed attempts at one world size
+      (the fleet "cannot respawn the full N") biases toward SHRINKING by
+      the number of culprit ranks, clamped at ``min_ranks``;
+    - a culprit rank the straggler detector holds verdicts against
+      (``straggler_total{rank}`` / recent ``straggler`` journal events)
+      is presumed-bad hardware: shrink after ``straggler_threshold``
+      failures (default: the first).
+
+    Every verdict is journaled as ``elastic_decision`` with its inputs.
+    """
+
+    def __init__(self, nproc: int, min_ranks: int = 1,
+                 repeat_threshold: int = 2, straggler_threshold: int = 1,
+                 healthy_secs: float = 300.0, grow_step: Optional[int] = None):
+        if min_ranks < 1 or min_ranks > nproc:
+            raise ValueError(f"min_ranks must be in [1, {nproc}], "
+                             f"got {min_ranks}")
+        self.nominal = int(nproc)
+        self.min_ranks = int(min_ranks)
+        self.repeat_threshold = max(1, int(repeat_threshold))
+        self.straggler_threshold = max(1, int(straggler_threshold))
+        self.healthy_secs = float(healthy_secs)
+        self.grow_step = grow_step   # None = grow straight back to nominal
+        self._consecutive = 0        # failed attempts since last success
+
+    # -- telemetry reads ----------------------------------------------------
+    @staticmethod
+    def straggler_verdicts() -> Dict[int, float]:
+        """rank -> straggler verdict count, from the metrics registry."""
+        from ..observability.metrics import REGISTRY
+        fam = REGISTRY.get("straggler_total")
+        out: Dict[int, float] = {}
+        if fam is None:
+            return out
+        for labels, child in fam.items():
+            rank = dict(labels).get("rank")
+            if rank is not None and child.value > 0:
+                try:
+                    out[int(rank)] = child.value
+                except ValueError:
+                    continue
+        return out
+
+    @staticmethod
+    def goodput_losses() -> Dict[str, float]:
+        """cause -> lost seconds, from the goodput ledger's counters."""
+        from ..observability.metrics import REGISTRY
+        fam = REGISTRY.get("lost_seconds_total")
+        if fam is None:
+            return {}
+        return {dict(labels).get("cause", "?"): child.value
+                for labels, child in fam.items()}
+
+    # -- the verdict --------------------------------------------------------
+    def decide(self, nproc: int, codes: Sequence[Optional[int]],
+               runtime_s: float, culprits: Optional[Sequence[int]] = None,
+               clean: Optional[bool] = None,
+               journal: bool = True) -> ElasticDecision:
+        """One verdict for the attempt that just ended with ``codes``."""
+        codes = list(codes)
+        if culprits is None:
+            bad = [r for r, c in enumerate(codes)
+                   if c not in (0, None, PREEMPTED_EXIT)]
+            pos = [r for r in bad if codes[r] > 0]
+            culprits = pos or bad   # prefer real failures over terminations
+        if clean is None:
+            clean = bool(codes) and all(
+                c in (0, PREEMPTED_EXIT) for c in codes if c is not None) \
+                and any(c == PREEMPTED_EXIT for c in codes)
+        healthy = runtime_s >= self.healthy_secs
+        stragglers = self.straggler_verdicts()
+        inputs = {"nproc": nproc, "exit_codes": codes,
+                  "culprits": list(culprits), "clean": clean,
+                  "runtime_s": round(float(runtime_s), 3),
+                  "consecutive_failures": self._consecutive,
+                  "straggler_verdicts": {str(k): v
+                                         for k, v in stragglers.items()},
+                  "goodput_lost_s": {k: round(v, 3) for k, v in
+                                     self.goodput_losses().items()}}
+        if clean or healthy:
+            self._consecutive = 0
+            if nproc < self.nominal:
+                target = min(self.nominal,
+                             nproc + (self.grow_step or self.nominal))
+                d = ElasticDecision(
+                    "grow", target,
+                    ("clean elastic event" if clean else
+                     f"healthy for {runtime_s:.0f}s before failing") +
+                    f" while below nominal {self.nominal}: grow back",
+                    inputs)
+            else:
+                d = ElasticDecision(
+                    "retry", nproc,
+                    "clean elastic event: relaunch at the same size"
+                    if clean else
+                    f"failure after {runtime_s:.0f}s healthy: transient, "
+                    f"retry at the same size", inputs)
+            return self._journal(d, journal)
+        self._consecutive += 1
+        inputs["consecutive_failures"] = self._consecutive
+        straggling = [r for r in culprits
+                      if stragglers.get(r, 0) >= 1]
+        shrink_by = max(1, len(set(culprits))) if culprits else 1
+        target = max(self.min_ranks, nproc - shrink_by)
+        if straggling and self._consecutive >= self.straggler_threshold \
+                and target < nproc:
+            d = ElasticDecision(
+                "shrink", target,
+                f"culprit rank(s) {sorted(set(straggling))} hold straggler "
+                f"verdicts: presumed-bad host, shrink to {target}", inputs)
+        elif self._consecutive >= self.repeat_threshold and target < nproc:
+            d = ElasticDecision(
+                "shrink", target,
+                f"{self._consecutive} consecutive failed attempts at "
+                f"{nproc} ranks: the fleet cannot hold this size, shrink "
+                f"to {target}", inputs)
+        else:
+            d = ElasticDecision(
+                "retry", nproc,
+                f"transient failure ({self._consecutive} consecutive, "
+                f"threshold {self.repeat_threshold}): retry at the same "
+                f"size", inputs)
+        return self._journal(d, journal)
+
+    def note_success(self):
+        """A fully-clean attempt finished: reset the failure streak."""
+        self._consecutive = 0
+
+    @staticmethod
+    def _journal(d: ElasticDecision, journal: bool) -> ElasticDecision:
+        if journal:
+            from ..observability import journal as _journal
+            _journal.emit({"event": "elastic_decision", "action": d.action,
+                           "target_nproc": d.target_nproc,
+                           "reason": d.reason, "inputs": d.inputs})
+        return d
+
+
+# ------------------------------------------------------- checkpointer hook --
+
+def note_world_change(dirname: str, old: dict, new: dict,
+                      program=None) -> Optional[ReshardPlan]:
+    """Restore-path hook: the checkpoint at ``dirname`` was saved under
+    ``old`` = {"nranks", "ndev"} and is being restored under ``new``.
+    Plans (and journals) the per-var redistribution so the resize is
+    auditable; failures degrade to a warning -- the actual load already
+    succeeded through ``io.load_vars``' reshard-on-load stitching, so a
+    planning hiccup must never fail the restore."""
+    try:
+        shard_vars = None
+        if program is not None:
+            # under a strategy only non-Parameter persistables (and params
+            # with reduce_params) ZeRO-shard; mirror state_sharding's gate
+            wrapper = getattr(program, "dist_strategy", None)
+            if wrapper is not None:
+                from ..compiler import BuildStrategy
+                from ..framework import Parameter
+                bs = program.build_strategy
+                reduce_mode = (bs.reduce_strategy ==
+                               BuildStrategy.ReduceStrategy.Reduce)
+                rp = bool(getattr(bs, "reduce_params", False))
+                gb = program.global_block()
+
+                def shard_vars(name, _gb=gb, _rm=reduce_mode, _rp=rp):
+                    if not _rm:
+                        return False
+                    v = _gb.vars.get(name)
+                    return v is not None and (
+                        not isinstance(v, Parameter) or _rp)
+        plan = plan_for_checkpoint(
+            dirname, int(new.get("ndev") or new.get("nranks") or 1),
+            shard_vars=shard_vars,
+            src_world=int(old.get("ndev") or old.get("nranks") or 1))
+        from ..observability import journal as _journal
+        _journal.emit({"event": "elastic_restore", "dir": str(dirname),
+                       "old": old, "new": new,
+                       "summary": plan.summary()})
+        return plan
+    except Exception as e:  # noqa: BLE001 -- advisory path, never fatal
+        import warnings
+        warnings.warn(f"paddle_tpu.elastic: reshard planning for "
+                      f"{dirname} failed ({type(e).__name__}: {e}); the "
+                      f"restore itself is unaffected")
+        return None
+
+
+def _main(argv=None) -> int:
+    """Tiny offline door: ``python -m paddle_tpu.resilience.elastic
+    --plan <ckpt-dir> --world N`` prints the journaled per-var plan."""
+    import argparse
+    ap = argparse.ArgumentParser("python -m paddle_tpu.resilience.elastic")
+    ap.add_argument("--plan", required=True, metavar="CKPT_DIR")
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--src-world", type=int, default=None)
+    args = ap.parse_args(argv)
+    plan = plan_for_checkpoint(args.plan, args.world,
+                               src_world=args.src_world, journal=False)
+    print(json.dumps(plan.to_dict(), indent=2, sort_keys=True))
+    print(plan.summary())
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

@@ -1,0 +1,190 @@
+"""Elastic chaos rank worker (launched by ``tools/chaos.py --ranks N``).
+
+One training rank of the kill-K-of-N elastic scenario: a seeded MLP
+regression under a ``StepGuardian`` + per-step ``Checkpointer``, with a
+hard ``kill`` fault armed on the doomed ranks.  The launcher's
+shrink-vs-wait controller observes the deaths and relaunches the
+survivors at a smaller world; this worker then restores the checkpoint,
+re-plans the batch schedule for the new world
+(:func:`elastic.replan_batch_schedule`), and finishes the run.
+
+The doomed-host simulation: a rank arms its kill fault whenever the
+CURRENT world still includes it (``rank >= nominal - K`` and
+``world > nominal - K``) -- the fleet genuinely cannot hold any world
+above N-K, exactly the "stop retrying N forever" scenario the elastic
+launcher exists for.
+
+Modes:
+
+- default (simulation): every rank trains the identical full global
+  batch (pure replication -- byte-identical ranks, no collectives), so
+  the whole scenario runs on any backend including single-device CPU.
+  Only rank 0 saves checkpoints; everyone restores from them.
+- ``--connect``: ranks join a real ``jax.distributed`` job and train
+  data-parallel with per-rank batch slices (needs a multiprocess-capable
+  backend; the test suite gates this leg on the backend probe).
+
+Output: one ``ELASTIC_RUN:{json}`` line with the rank's world/attempt/
+start step and per-step losses (both repr and ``float.hex()`` for the
+byte-consistency comparison).  A rank preempted mid-run (the launcher
+terminating survivors after a peer died) exits with
+``resilience.PREEMPTED_EXIT`` -- the clean elastic exit code.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_workload(dim: int, seed: int):
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [dim], "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, dim))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def global_batch_for(step: int, batch: int, dim: int, seed: int):
+    """The deterministic GLOBAL batch of a given global step: every rank
+    regenerates it identically, then feeds its slice (connect mode) or
+    the whole thing (simulation mode)."""
+    import numpy as np
+    rs = np.random.RandomState((seed + 1) * 100003 + step)
+    return rs.rand(batch, dim).astype("float32")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("paddle_tpu.resilience.elastic_worker")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=24,
+                    help="GLOBAL batch size (connect mode feeds per-rank "
+                         "slices of it)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ckpt", required=True)
+    ap.add_argument("--kill-ranks", default="",
+                    help="comma list of doomed rank ids (of the NOMINAL "
+                         "world)")
+    ap.add_argument("--kill-step", type=int, default=None)
+    ap.add_argument("--connect", action="store_true",
+                    help="join a real jax.distributed job (data-parallel "
+                         "slices; needs a multiprocess backend)")
+    ap.add_argument("--restore-step", type=int, default=None,
+                    help="restore exactly this checkpoint step (the "
+                         "byte-consistency comparison run)")
+    ap.add_argument("--no-save", action="store_true")
+    ap.add_argument("--step-secs", type=float, default=0.0,
+                    help="pace each step (keeps the scenario mid-epoch "
+                         "relative to the launcher's poll interval)")
+    args = ap.parse_args(argv)
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    nominal = int(os.environ.get("PADDLE_NOMINAL_TRAINERS_NUM", str(world)))
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import resilience
+    from paddle_tpu.resilience import elastic, faults, recovery
+    from paddle_tpu.utils.checkpointer import Checkpointer
+
+    if args.connect and world > 1:
+        from paddle_tpu.parallel import env as penv
+        penv.init_parallel_env()
+
+    kill = sorted(int(r) for r in args.kill_ranks.split(",") if r.strip())
+    K = len(kill)
+    # this rank is a doomed host when its id is in the kill list AND the
+    # current world is still too wide to run without the dead hosts --
+    # once the launcher has shrunk to nominal-K ranks the survivors fit
+    doomed = (K > 0 and args.kill_step is not None and
+              world > nominal - K and rank in kill)
+
+    main_p, startup, loss = build_workload(args.dim, args.seed)
+    target = main_p
+    if args.connect and world > 1:
+        target = fluid.CompiledProgram(main_p).with_data_parallel(
+            loss_name=loss.name)
+
+    saver = (not args.no_save) and (args.connect or world == 1 or rank == 0)
+    record = {"rank": rank, "world": world, "nominal": nominal,
+              "attempt": attempt, "doomed": doomed, "start": 0,
+              "restored": -1, "replan": None, "losses": [],
+              "losses_hex": []}
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    code = 0
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck = Checkpointer(exe, target, args.ckpt, save_interval_steps=1,
+                          max_to_keep=200)
+        if args.restore_step is not None:
+            restored = ck.restore(step=args.restore_step)
+        else:
+            restored = ck.restore()
+        record["restored"] = restored
+        start = restored + 1 if restored >= 0 else 0
+        record["start"] = start
+        ts = ck.train_state or {}
+        old_world = int(ts.get("launcher_world", world))
+        if restored >= 0 and old_world != world:
+            # the world changed under us: re-derive the batch schedule
+            # (journals a batch_replan event; slices drive connect mode)
+            record["replan"] = elastic.replan_batch_schedule(
+                ts, old_world, world, global_batch=args.batch)
+        if doomed:
+            # the doomed-host simulation must kill at a step this attempt
+            # will actually REACH: a resumed run past --kill-step still
+            # dies (the host is gone for good), at its first new step
+            faults.install(faults.Fault(kind="kill", site="dispatch",
+                                        step=max(args.kill_step, start)))
+        g = recovery.StepGuardian(
+            exe, target, checkpointer=ck if saver else None,
+            handle_signals=True, max_retries=2, retry_backoff=0.01,
+            retry_seed=args.seed, start_step=start)
+
+        my_slice = None
+        if args.connect and world > 1:
+            # the slice table is constant for the attempt: derive once
+            my_slice = elastic.replan_batch_schedule(
+                {}, world, world, global_batch=args.batch,
+                journal=False)["rank_slices"][rank]
+
+        def feed_for(step):
+            gx = global_batch_for(step, args.batch, args.dim, args.seed)
+            if my_slice is not None:
+                gx = gx[my_slice[0]:my_slice[1]]
+            return {"x": gx}
+
+        try:
+            import time
+            for step in range(start, args.steps):
+                if saver:
+                    ck.update_train_state(epoch=0, batch=step + 1,
+                                          launcher_world=world)
+                vals = g.run(feed=feed_for(step), fetch_list=[loss])
+                v = float(np.asarray(vals[0]).reshape(-1)[0])
+                record["losses"].append(v)
+                record["losses_hex"].append(v.hex())
+                if args.step_secs:
+                    time.sleep(args.step_secs)
+            g.close()
+        except recovery.Preempted:
+            # a peer died and the launcher terminated us (or an injected
+            # preempt): leave through the CLEAN elastic exit so the
+            # launcher does not bill the restart budget for our exit
+            code = resilience.PREEMPTED_EXIT
+    print("ELASTIC_RUN:" + json.dumps(record), flush=True)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

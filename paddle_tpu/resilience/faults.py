@@ -18,11 +18,15 @@ Spec grammar (entries separated by ``;``)::
     exc@checkpoint_write:times=2   # first two checkpoint writes fail
     hang@fetch:step=4:seconds=30   # artificial hang (trips the step deadline)
     preempt:step=7                 # simulated SIGTERM (preemption flag)
+    kill:step=5                    # hard rank death: SIGKILL this process
+    kill:step=5:value=75           # ... or _exit(75) (clean preempt exit)
     corrupt:step=5:seed=1          # bit-flip a written checkpoint chunk
     truncate:step=5                # cut a written checkpoint chunk in half
 
 Kinds: ``nan`` (also ``value=inf|-inf|<float>``), ``exc``, ``hang``,
-``preempt``, ``corrupt``, ``truncate``.  Sites: ``compile``, ``dispatch``,
+``preempt``, ``kill`` (hard ``SIGKILL``/``os._exit`` of the current rank
+-- rank-death chaos for the elastic launcher; ``value=<int>`` picks the
+exit code), ``corrupt``, ``truncate``.  Sites: ``compile``, ``dispatch``,
 ``fetch``, ``checkpoint_write`` (``nan`` ignores the site -- it corrupts
 the step's outputs/state by tensor name; ``corrupt``/``truncate`` only
 make sense at ``checkpoint_write``, where they damage the files the save
@@ -51,10 +55,11 @@ from ..observability.metrics import REGISTRY as _OBS
 
 ENV_VAR = "PADDLE_TPU_FAULTS"
 
-KINDS = ("nan", "exc", "hang", "preempt", "corrupt", "truncate")
+KINDS = ("nan", "exc", "hang", "preempt", "kill", "corrupt", "truncate")
 SITES = ("compile", "dispatch", "fetch", "checkpoint_write")
 _DEFAULT_SITE = {"nan": "fetch", "exc": "dispatch", "hang": "fetch",
-                 "preempt": "dispatch", "corrupt": "checkpoint_write",
+                 "preempt": "dispatch", "kill": "dispatch",
+                 "corrupt": "checkpoint_write",
                  "truncate": "checkpoint_write"}
 #: kinds that are NOT raised/slept at a fire() hook point: ``nan`` corrupts
 #: step outputs (corrupt_step), ``corrupt``/``truncate`` damage the files a
@@ -258,6 +263,16 @@ def fire(site: str, step: Optional[int] = None, program=None):
                 f"injected preempt fault (step {step})")
         elif f.kind == "hang":
             time.sleep(f.seconds)
+        elif f.kind == "kill":
+            # hard rank death (the elastic-training chaos primitive): no
+            # emergency save, no atexit, no flushed buffers -- exactly
+            # what a lost host looks like to the launcher.  value=<int>
+            # swaps SIGKILL for an immediate _exit with that code (e.g.
+            # value=75 simulates a clean preempted exit).
+            import signal as _signal
+            if math.isnan(f.value):
+                os.kill(os.getpid(), _signal.SIGKILL)
+            os._exit(int(f.value))
         else:  # exc
             raise TransientFault(
                 f"UNAVAILABLE: injected transient fault at {site} "

@@ -250,8 +250,14 @@ class Checkpointer:
             from .. import io
             prog, _ = io._unwrap_program(self.program)
             counter = int(getattr(prog, "_rng_run_counter", 0))
+        import jax
         doc = {"format_version": 1, "step": int(step),
-               "rng_counter": counter}
+               "rng_counter": counter,
+               # the world this state was saved under: restore compares it
+               # against its own and plans the reshard when they differ
+               # (elastic world-size-changing resume, ISSUE 11)
+               "world": {"nranks": jax.process_count(),
+                         "ndev": jax.device_count()}}
         doc.update(self._train_state)
         return doc
 
@@ -395,7 +401,7 @@ class Checkpointer:
                        "reason": reason[:300]})
         return dst if moved else None
 
-    def restore(self, program=None) -> int:
+    def restore(self, program=None, step: Optional[int] = None) -> int:
         """Load the newest complete checkpoint; returns its step or -1.
         Pass a CompiledProgram to reshard-on-load into a new mesh.
 
@@ -404,9 +410,43 @@ class Checkpointer:
         journaled) and the scan falls through to the next complete step.
         On success the program's rng run counter is rewound to the saved
         value and ``.train_state`` holds the checkpoint's
-        ``trainstate.json`` (dataset position for exact resume)."""
+        ``trainstate.json`` (dataset position for exact resume).
+
+        ``step`` pins an EXACT checkpoint step instead of the newest
+        (elastic byte-consistency comparisons, forensic re-runs): a
+        missing or corrupt pinned step raises instead of falling through
+        -- restoring a different step than asked would silently compare
+        apples to oranges."""
         from .. import io
         target = program or self.program
+        if step is not None:
+            d = self._step_dir(step)
+            err = None
+            try:
+                if not self._is_complete(d):
+                    raise FileNotFoundError(
+                        f"checkpoint ckpt-{step} at {self.dirname} is "
+                        f"missing or incomplete (restore(step={step}) "
+                        f"does not fall through)")
+                io.load_persistables(self.exe, d, target)
+            except (io.CheckpointCorruption, FileNotFoundError,
+                    RuntimeError) as e:
+                err = e
+            # the verdict must be COLLECTIVE like the scanning path's: a
+            # rank raising alone while its peers proceed into the next
+            # collective would hang the survivors forever
+            if self._any_rank_failed(err is not None):
+                if err is not None:
+                    raise err
+                raise io.CheckpointCorruption(
+                    f"checkpoint ckpt-{step} failed to restore on "
+                    f"another rank (restore(step={step}) does not fall "
+                    f"through)", kind="crc", path=d)
+            self._apply_trainstate(d, target)
+            self._note_world_change(d, target)
+            self._last_save_step = step
+            self._restored_step = step
+            return step
         prev = None
         while True:
             step = self.latest_step()
@@ -437,9 +477,32 @@ class Checkpointer:
                     else "corrupt on another rank")
                 continue
             self._apply_trainstate(d, target)
+            self._note_world_change(d, target)
             self._last_save_step = step
             self._restored_step = step
             return step
+
+    def _note_world_change(self, d, target):
+        """Elastic resume (ISSUE 11): when the checkpoint's recorded world
+        differs from the current one, plan and journal the per-var
+        redistribution (``reshard_plan`` + ``elastic_restore`` events).
+        Same-world restores skip this entirely -- no planner import, no
+        manifest re-read -- and a planning failure never fails the
+        restore (the load itself already resharded via io.load_vars)."""
+        saved = (self.train_state or {}).get("world")
+        if not saved:
+            return
+        import jax
+        cur = {"nranks": jax.process_count(), "ndev": jax.device_count()}
+        try:
+            same = (int(saved.get("nranks", 0)) == cur["nranks"] and
+                    int(saved.get("ndev", 0)) == cur["ndev"])
+        except (TypeError, ValueError):
+            same = True   # unreadable world record: nothing to compare
+        if same:
+            return
+        from ..resilience import elastic as _elastic
+        _elastic.note_world_change(d, saved, cur, program=target)
 
     def _any_rank_failed(self, failed: bool) -> bool:
         """All-ranks OR of a local verdict (identity single-host).  Every

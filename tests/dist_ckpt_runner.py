@@ -2,7 +2,13 @@
 N processes train a ZeRO-sharded MLP under a Checkpointer (per-rank chunk
 manifests, rank0 LATEST + post-barrier rotation), then restore into a fresh
 scope and print a state digest -- the parent asserts the digests agree
-across ranks and the surviving tree passes the crc verifier."""
+across ranks and the surviving tree passes the crc verifier.
+
+A 5th argv ``shrink-restore`` is the elastic world-shrink variant (ISSUE
+11): a SINGLE fresh process restores the checkpoint the N-proc run wrote
+-- a 2-proc -> 1-proc world change -- asserting the restore re-plans the
+shards (``reshard_plan``/``elastic_restore`` journal events), and
+continues training with a finite loss."""
 import hashlib
 import json
 import os
@@ -14,6 +20,7 @@ def main():
     nproc = int(sys.argv[2])
     port = sys.argv[3]
     ckpt_dir = sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else ""
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -78,6 +85,29 @@ def main():
             else:
                 h.update(np.ascontiguousarray(np.asarray(v)).tobytes())
         return h.hexdigest()
+
+    if mode == "shrink-restore":
+        # elastic shrink: this 1-proc world restores the 2-proc ZeRO
+        # checkpoint; the restore path must re-plan the shards for the
+        # new world (journaled) and training must continue
+        from paddle_tpu.observability import journal as pjournal
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            ck = Checkpointer(exe, cp, ckpt_dir)
+            got = ck.restore()
+            plans = [e for e in pjournal.recent(event="reshard_plan")]
+            notes = [e for e in pjournal.recent(event="elastic_restore")]
+            loss_val = float(__import__("numpy").asarray(
+                exe.run(cp, feed=feed(), fetch_list=[loss])[0]).reshape(-1)[0])
+            print("SHRINK:" + json.dumps({
+                "restored": got,
+                "saved_world": (ck.train_state or {}).get("world"),
+                "reshard_plans": len(plans),
+                "plan_actions": plans[-1].get("actions") if plans else None,
+                "elastic_restores": len(notes),
+                "loss": loss_val}), flush=True)
+        return
 
     exe = fluid.Executor()
     with fluid.scope_guard(fluid.Scope()):

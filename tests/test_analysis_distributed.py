@@ -317,6 +317,48 @@ def test_pt046_reduce_params_regather_warn():
         main, feed_names=["x"], fetch_names=[loss.name], strategy=cp2))
 
 
+def test_pt047_hardcoded_batch_pins_world_size():
+    """Elastic-incompatibility lint: a data var whose batch dim is
+    hardcoded to a multiple of the current dp degree works today but
+    breaks on the first resize -- warn before the first kill."""
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (16, 4), "float32", is_data=True)   # 16 % 8 == 0
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    diags = analysis.verify(p, strategy=dp8())
+    d = next(d for d in diags if d.code == "PT047")
+    assert d.severity == "warn" and d.var == "x"
+    assert "elastic" in d.message and "-1" in d.message
+    # dynamic batch dim: resize-safe, no warning
+    p2 = Program()
+    b2 = p2.global_block()
+    b2.create_var("x", (-1, 4), "float32", is_data=True)
+    b2.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    assert "PT047" not in codes(analysis.verify(p2, strategy=dp8()))
+    # indivisible batch is PT045's error, not a second PT047
+    p3 = Program()
+    b3 = p3.global_block()
+    b3.create_var("x", (12, 4), "float32", is_data=True)   # 12 % 8 != 0
+    b3.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    d3 = analysis.verify(p3, strategy=dp8())
+    assert "PT045" in codes(d3) and "PT047" not in codes(d3)
+
+
+def test_pt047_needs_explicit_mesh_and_sharded_batch():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (16, 4), "float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    # default mesh (dp = device count): unknown statically, no warning
+    assert "PT047" not in codes(analysis.verify(
+        p, strategy=fluid.DistributedStrategy()))
+    # batch dim explicitly replicated by a data rule: resize-safe
+    unsharded = fluid.DistributedStrategy(
+        mesh_shape={"dp": 8}, data_rules=[(r"^x$", (None, None))])
+    got = codes(analysis.verify(p, strategy=unsharded))
+    assert "PT047" not in got, got
+
+
 def test_pt046_unshardable_state_warn():
     """Reduce mode with an accumulator no dim of which divides dp: the
     ZeRO memory win silently doesn't happen -- warn."""

@@ -158,6 +158,31 @@ def test_multihost_checkpointer_save_restore(tmp_path):
     assert any(s["nranks"] == 2 for s in rep["steps"]), rep
 
 
+@requires_multiprocess_backend
+def test_multihost_shrink_restore_2proc_to_1proc(tmp_path):
+    """Elastic world shrink (ISSUE 11): the ZeRO checkpoint a 2-proc run
+    wrote restores into a FRESH 1-proc world -- the restore path re-plans
+    the shards for the smaller world (``reshard_plan`` journaled with the
+    old/new world), and training continues with a finite loss."""
+    import math
+    tree = tmp_path / "ck"
+    _launch(2, _free_port(), tree, runner=_CKPT_RUNNER)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    p = subprocess.run(
+        [sys.executable, _CKPT_RUNNER, "0", "1", "0", str(tree),
+         "shrink-restore"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    d = _tagged(p.stdout, "SHRINK")
+    assert d["restored"] == 2, d
+    assert d["saved_world"] and d["saved_world"]["nranks"] == 2, d
+    assert d["reshard_plans"] >= 1 and d["elastic_restores"] >= 1, d
+    assert d["plan_actions"], d
+    assert math.isfinite(d["loss"]), d
+
+
 def test_pipeline_spmd_matches_serial():
     """Explicit GPipe over pp=4: outputs equal serial stage application."""
     import jax

@@ -121,7 +121,8 @@ def render_health(events: List[dict]) -> str:
 # ------------------------------------------------------------- resilience --
 
 _RESILIENCE_EVENTS = ("fault", "retry", "skip", "rollback", "preempt",
-                      "step_timeout", "elastic_restart")
+                      "step_timeout", "elastic_restart",
+                      "elastic_decision", "reshard_plan")
 
 
 def render_resilience(events: List[dict]) -> str:
@@ -175,6 +176,14 @@ def render_resilience(events: List[dict]) -> str:
                          f"{e.get('max_restarts')}: rank "
                          f"{e.get('failed_rank')} failed, backoff "
                          f"{e.get('backoff_s')}s")
+    for e in by["elastic_decision"][-10:]:
+        lines.append(f"  elastic decision: {e.get('action')} -> "
+                     f"{e.get('target_nproc')} rank(s) "
+                     f"({str(e.get('reason'))[:80]})")
+    for e in by["reshard_plan"][-5:]:
+        lines.append(f"  reshard plan {e.get('src_world')} -> "
+                     f"{e.get('dst_world')}: {e.get('actions')} "
+                     f"({e.get('bytes_read')} B read)")
     return "\n".join(lines)
 
 
