@@ -15,12 +15,24 @@ batch for a single-executable deployment.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from .core.executor import Scope, trace_block
 from .framework import Program
+
+#: serving dtypes Predictor can cast to; None means "native" (serve in the
+#: saved model's own dtypes, the historical behavior, byte-identical).
+SERVING_DTYPES = (None, "float32", "bfloat16")
+
+
+def _norm_dtype(dtype) -> Optional[str]:
+    if dtype in SERVING_DTYPES:
+        return dtype
+    raise ValueError(
+        f"serving dtype {dtype!r} invalid; use one of {SERVING_DTYPES}")
 
 
 class AnalysisConfig:
@@ -45,6 +57,9 @@ class AnalysisConfig:
         pass   # XLA buffer reuse is always on
 
     def enable_bfloat16(self):
+        """Serve in bfloat16 (the reference's MKLDNN bf16 knob; TPU-native
+        half precision here): pinned parameters and floating-point feeds are
+        cast, and outputs come back in the computed (bf16) dtype."""
         self._use_bf16 = True
 
 
@@ -52,7 +67,7 @@ class Predictor:
     """AOT-compiled serving session over a save_inference_model directory."""
 
     def __init__(self, model_dir: str, model_filename=None,
-                 params_filename=None):
+                 params_filename=None, dtype: Optional[str] = None):
         import jax
         from . import io
         self._scope = Scope()
@@ -63,6 +78,7 @@ class Predictor:
         self.program: Program = prog
         self.feed_names: List[str] = list(feeds)
         self.fetch_names: List[str] = list(fetches)
+        self._dtype = _norm_dtype(dtype)
         # pin parameters on device once (the C++ predictor's pinned
         # buffers); weights read only inside control-flow sub-blocks count
         # too (the same traversal Executor._state_names does), and only the
@@ -73,19 +89,82 @@ class Predictor:
                        for n in self._scope.var_names()
                        if n in needed and self._scope.find_var(n) is not None}
         self._compiled = {}
+        # concurrent run(): the executable cache and the per-signature
+        # compile are both guarded -- _lock covers the dict/lock-table,
+        # one lock per signature serializes its (seconds-long) XLA compile
+        # so N threads racing a cold signature compile it exactly once
+        self._lock = threading.Lock()
+        self._sig_locks: Dict[tuple, threading.Lock] = {}
+        # per-dtype pinned state (the bf16 serving path keeps its own cast
+        # copy on device, built lazily on first use)
+        self._states: Dict[Optional[str], Dict[str, object]] = {
+            None: self._state}
+
+    # -- serving dtype -----------------------------------------------------------------
+    def _state_for(self, dtype: Optional[str]) -> Dict[str, object]:
+        """Pinned device state for a serving dtype; ``None`` = native.
+        Float leaves cast once and stay pinned; integer/bool state (vocab
+        tables, positions) is never touched."""
+        state = self._states.get(dtype)
+        if state is not None:
+            return state
+        with self._lock:
+            state = self._states.get(dtype)
+            if state is None:
+                import jax.numpy as jnp
+                state = {
+                    n: (jnp.asarray(v, dtype)
+                        if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                        and str(jnp.asarray(v).dtype) != dtype else v)
+                    for n, v in self._state.items()}
+                self._states[dtype] = state
+        return state
+
+    def _cast_feed(self, feed: Dict[str, np.ndarray],
+                   dtype: Optional[str]) -> Dict[str, np.ndarray]:
+        if dtype is None:
+            return feed
+        import jax.numpy as jnp
+        np_dtype = jnp.dtype(dtype)
+        return {k: (v.astype(np_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating)
+                    and v.dtype != np_dtype else v)
+                for k, v in feed.items()}
 
     # -- compilation -------------------------------------------------------------------
-    def _executable(self, feed: Dict[str, np.ndarray]):
+    def _executable(self, feed: Dict[str, np.ndarray],
+                    dtype: Optional[str] = None):
+        """(executable, cold) for this feed signature. Thread-safe: exactly
+        one thread compiles a new signature (and is the only one labeled
+        cold); the rest block on the signature's lock and get the warm
+        executable."""
         import jax
         from .observability.metrics import REGISTRY as _OBS
-        sig = tuple((k, tuple(np.shape(feed[k])),
-                     str(np.asarray(feed[k]).dtype)) for k in self.feed_names)
+
+        def _count(outcome):
+            _OBS.counter("predictor_executable_cache_total",
+                         "Predictor AOT-executable cache lookups by outcome",
+                         outcome=outcome).inc()
+
+        sig = (dtype,) + tuple(
+            (k, tuple(np.shape(feed[k])),
+             str(np.asarray(feed[k]).dtype)) for k in self.feed_names)
         exe = self._compiled.get(sig)
-        _OBS.counter("predictor_executable_cache_total",
-                     "Predictor AOT-executable cache lookups by outcome",
-                     outcome="hit" if exe is not None else "miss").inc()
-        if exe is None:
+        if exe is not None:
+            _count("hit")
+            return exe, False
+        with self._lock:
+            lk = self._sig_locks.setdefault(sig, threading.Lock())
+        with lk:
+            exe = self._compiled.get(sig)
+            if exe is not None:
+                # another thread just compiled it while we waited: this
+                # request is served warm and must not be labeled cold
+                _count("hit")
+                return exe, False
+            _count("miss")
             block = self.program.global_block()
+            state = self._state_for(dtype)
 
             def fwd(state, inputs):
                 env = dict(state)
@@ -93,19 +172,21 @@ class Predictor:
                 trace_block(block, env, jax.random.PRNGKey(0))
                 return [env[n] for n in self.fetch_names]
 
-            args = (self._state,
+            args = (state,
                     {k: jax.ShapeDtypeStruct(np.shape(feed[k]),
                                              np.asarray(feed[k]).dtype)
                      for k in self.feed_names})
             exe = jax.jit(fwd).lower(*args).compile()   # AOT: no retrace
             self._compiled[sig] = exe
-        return exe
+        return exe, True
 
     # -- serving -----------------------------------------------------------------------
-    def run(self, inputs) -> List[np.ndarray]:
+    def run(self, inputs, dtype: Optional[str] = None) -> List[np.ndarray]:
         """inputs: dict name->array, or list of arrays ordered as feed_names
         (the C++ Run() contract). Returns numpy outputs ordered as
-        fetch_names."""
+        fetch_names. ``dtype`` overrides the session serving dtype for this
+        call (None = the session's; the serving tier's per-bucket
+        ``serving.dtype`` autotune decision lands here)."""
         import time
         from .observability import health as _health
         from .observability import journal as _journal
@@ -130,13 +211,13 @@ class Predictor:
                 f"Predictor.run got unexpected inputs {unexpected}; the "
                 f"model feeds are {self.feed_names}")
         t0 = time.perf_counter()
-        n_compiled = len(self._compiled)
-        exe = self._executable(inputs)
-        cold = len(self._compiled) > n_compiled  # this request paid a compile
+        dt_serve = _norm_dtype(dtype) if dtype is not None else self._dtype
         with _timeline.phase("feed_prep", cat="predictor"):
-            feed = {k: np.asarray(inputs[k]) for k in self.feed_names}
+            feed = self._cast_feed(
+                {k: np.asarray(inputs[k]) for k in self.feed_names}, dt_serve)
+        exe, cold = self._executable(feed, dt_serve)
         with _timeline.phase("dispatch", cat="predictor"):
-            outs = exe(self._state, feed)
+            outs = exe(self._state_for(dt_serve), feed)
         with _timeline.phase("fetch_sync", cat="predictor"):
             outs = [np.asarray(o) for o in outs]   # np.asarray = d2h sync
         hmode = _health.mode()
@@ -174,4 +255,5 @@ class Predictor:
 
 def create_paddle_predictor(config: AnalysisConfig) -> Predictor:
     """Reference CreatePaddlePredictor(AnalysisConfig)."""
-    return Predictor(config.model_dir, config.model_file, config.params_file)
+    return Predictor(config.model_dir, config.model_file, config.params_file,
+                     dtype="bfloat16" if config._use_bf16 else None)

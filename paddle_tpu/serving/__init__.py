@@ -1,0 +1,38 @@
+"""Production serving tier: continuous batching + multi-tenant Predictor
+pool.
+
+The layer between concurrent clients and the AOT
+:class:`~paddle_tpu.inference.Predictor` (the reference's
+AnalysisPredictor-behind-a-server capability class):
+
+- :mod:`~paddle_tpu.serving.batcher` -- dynamic batcher coalescing
+  concurrent requests into pow2-bucketed batch shapes with per-request
+  de-slicing byte-equal to solo serving;
+- :mod:`~paddle_tpu.serving.pool` -- :class:`PredictorPool`: N Predictors
+  + workers, bounded-queue admission control with explicit typed shed,
+  per-tenant quotas and weighted fair dequeue, graceful drain, the
+  ``serving.dtype`` autotune knob, and SLO metrics on the PR-9
+  ``/metrics`` endpoint.
+
+Deliberately NOT imported by ``paddle_tpu/__init__.py``: a process that
+never serves pays nothing -- ``Predictor.run`` without this import opens
+no threads and no queues (guard-tested).
+
+    from paddle_tpu.serving import PredictorPool
+    pool = PredictorPool("model_dir", size=2, max_batch=32, max_wait_ms=2)
+    out, = pool.run({"x": batch})          # or pool.submit(...).result()
+    pool.close()                           # graceful drain
+
+``python -m paddle_tpu.serving --selftest`` runs the hermetic fake-clock
+batcher drills plus a tiny-MLP pool round-trip (pinned by the test suite).
+"""
+from .batcher import (Batch, Clock, DynamicBatcher, FakeClock,
+                      MonotonicClock, Request, RequestShed, ServingError,
+                      SimpleQueue, row_signature)
+from .pool import PredictorPool, ServingDtype, TenantQueue
+
+__all__ = [
+    "Batch", "Clock", "DynamicBatcher", "FakeClock", "MonotonicClock",
+    "PredictorPool", "Request", "RequestShed", "ServingDtype",
+    "ServingError", "SimpleQueue", "TenantQueue", "row_signature",
+]

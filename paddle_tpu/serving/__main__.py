@@ -1,0 +1,202 @@
+"""Serving-tier CLI.
+
+    python -m paddle_tpu.serving --selftest     # pinned by the test suite
+
+The selftest is two-stage: (1) hermetic fake-clock batcher/queue drills --
+no JAX, no threads, no sleeps -- covering coalescing, pow2 padding,
+deadline, signature isolation, admission control, quota shed and weighted
+fair dequeue; (2) a tiny-MLP ``PredictorPool`` round-trip proving batched
+outputs byte-equal solo ``Predictor.run`` and that the serving metrics +
+``tools/obs_report`` Serving section carry the signal.
+
+Exit codes: 0 ok, 1 failure.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _selftest_batcher() -> None:
+    """Stage 1: hermetic fake-clock drills (no jax import)."""
+    import numpy as np
+
+    from .batcher import (Batch, DynamicBatcher, FakeClock, Request,
+                          ServingError, SimpleQueue)
+    from .pool import TenantQueue
+
+    clock = FakeClock()
+
+    # ragged coalescing + pow2 padding, FIFO order preserved
+    q = SimpleQueue(clock=clock)
+    reqs = [Request({"x": np.zeros((n, 4), "float32")}, t_submit=clock.now())
+            for n in (1, 3, 2, 1)]
+    for r in reqs:
+        q.push(r)
+    b = DynamicBatcher(max_batch=8, max_wait_ms=5.0, clock=clock).form(
+        q, timeout=0.01)
+    assert [r.rows for r in b.requests] == [1, 3, 2, 1], b.requests
+    assert b.rows == 7 and b.padded_rows == 8, (b.rows, b.padded_rows)
+    feed = b.feed()
+    assert feed["x"].shape == (8, 4)
+
+    # max_batch row cap: the 5th request stays queued
+    q = SimpleQueue(clock=clock)
+    for _ in range(5):
+        q.push(Request({"x": np.zeros((2, 4), "float32")}))
+    b = DynamicBatcher(max_batch=8, max_wait_ms=0.0, clock=clock).form(q)
+    assert b.rows == 8 and q.depth() == 1, (b.rows, q.depth())
+
+    # deadline: a lone request waits max_wait_ms on the fake clock, then
+    # serves alone (the wait was recorded, nothing slept for real)
+    clock = FakeClock()
+    q = SimpleQueue(clock=clock)
+    q.push(Request({"x": np.zeros((1, 4), "float32")}))
+    t0 = clock.now()
+    b = DynamicBatcher(max_batch=8, max_wait_ms=3.0, clock=clock).form(q)
+    assert b.rows == 1 and clock.now() - t0 >= 3e-3 and clock.waits
+    assert b.padded_rows == 1
+
+    # signature isolation: different trailing shapes never mix
+    q = SimpleQueue(clock=clock)
+    q.push(Request({"x": np.zeros((1, 4), "float32")}))
+    q.push(Request({"x": np.zeros((1, 8), "float32")}))
+    b = DynamicBatcher(max_batch=8, max_wait_ms=0.0, clock=clock).form(q)
+    assert b.rows == 1 and q.depth() == 1
+
+    # oversize request serves whole, padded to its own pow2 bucket
+    q = SimpleQueue(clock=clock)
+    q.push(Request({"x": np.zeros((20, 4), "float32")}))
+    b = DynamicBatcher(max_batch=8, max_wait_ms=0.0, clock=clock).form(q)
+    assert b.rows == 20 and b.padded_rows == 32
+
+    # non-row-wise output fails the batch with a typed ServingError
+    r = Request({"x": np.zeros((2, 4), "float32")})
+    bb = Batch([r])
+    bb.scatter([np.float32(0.5)])   # a batch-reduced scalar fetch
+    try:
+        r.result(timeout=0)
+        raise AssertionError("scalar fetch must fail the batch")
+    except ServingError:
+        pass
+
+    # admission control: global bound + tenant quota, typed reasons
+    tq = TenantQueue(max_queue=3, quotas={"a": 1}, clock=FakeClock())
+    mk = lambda t: Request({"x": np.zeros((1, 2), "float32")}, tenant=t)
+    assert tq.try_push(mk("a")) is None
+    assert tq.try_push(mk("a")) == "tenant_quota"
+    assert tq.try_push(mk("b")) is None
+    assert tq.try_push(mk("b")) is None
+    assert tq.try_push(mk("b")) == "queue_full"
+
+    # weighted fair dequeue: weight 3:1 -> ~3x the rows under contention
+    tq = TenantQueue(max_queue=64, weights={"a": 3.0, "b": 1.0},
+                     clock=FakeClock())
+    for _ in range(8):
+        tq.try_push(mk("a"))
+        tq.try_push(mk("b"))
+    order = [tq.pop_first(timeout=0.01).tenant for _ in range(8)]
+    assert order.count("a") == 6 and order.count("b") == 2, order
+
+
+def _selftest_pool() -> None:
+    """Stage 2: tiny-MLP pool round-trip, byte-equal to solo serving."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import Predictor
+    from paddle_tpu.observability import journal as _journal
+    from paddle_tpu.observability.export import to_dict
+    from .pool import PredictorPool
+
+    with tempfile.TemporaryDirectory() as d:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data("x", [8], "float32")
+            y = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 4)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.io.save_inference_model(d, ["x"], [y], exe, main)
+
+        rng = np.random.RandomState(0)
+        feeds = [rng.randn(n, 8).astype("float32") for n in (1, 2, 3, 1, 2)]
+        solo = Predictor(d)
+        refs = [solo.run({"x": f})[0] for f in feeds]
+
+        pool = PredictorPool(d, size=2, max_batch=8, max_wait_ms=10.0,
+                             max_queue=32)
+        try:
+            results = [None] * len(feeds)
+
+            def client(i):
+                results[i] = pool.run({"x": feeds[i]},
+                                      tenant=f"t{i % 2}", timeout=120)[0]
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(len(feeds))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            for got, ref in zip(results, refs):
+                assert got.tobytes() == ref.tobytes(), \
+                    "batched output != solo Predictor.run bytes"
+        finally:
+            pool.close()
+        # after close(drain=True) the workers are joined, so the in-flight
+        # count is settled (reading it before close races the worker's
+        # post-scatter decrement)
+        assert pool.in_flight == 0
+        assert pool.queue_depth() == 0
+
+        # metrics + obs_report Serving section carry the signal
+        snap = to_dict()
+        names = {f["name"] for f in snap.get("families", [])}
+        for must in ("serving_batch_rows", "serving_request_seconds",
+                     "serving_requests_total"):
+            assert must in names, f"{must} missing from registry"
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        try:
+            from tools.obs_report import render_serving
+        except ImportError:
+            render_serving = None   # installed without the repo's tools/
+        if render_serving is not None:
+            report = render_serving(_journal.recent(), snap)
+            for must in ("== Serving ==", "batches", "p99"):
+                assert must in report, f"{must!r} missing from:\n{report}"
+
+
+def selftest() -> int:
+    _selftest_batcher()
+    _selftest_pool()
+    print("serving selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving",
+        description="serving tier: continuous batching + multi-tenant "
+                    "Predictor pool (see bench_inference.py --serve-qps "
+                    "for the load benchmark)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="hermetic fake-clock batcher drills + tiny-MLP "
+                         "pool round-trip")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

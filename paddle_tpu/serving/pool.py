@@ -1,0 +1,504 @@
+"""Multi-tenant Predictor pool: admission control, weighted fair dequeue,
+graceful drain -- the scheduling half of the serving tier.
+
+``PredictorPool`` owns N AOT :class:`~paddle_tpu.inference.Predictor`
+instances and N worker threads. Clients ``submit()`` (future) or ``run()``
+(blocking); workers pull bucketed batches formed by
+:class:`~paddle_tpu.serving.batcher.DynamicBatcher` from a
+:class:`TenantQueue` and serve them.
+
+Admission control is explicit-shed, never unbounded memory: a full global
+queue (``max_queue`` requests) or an exhausted per-tenant quota rejects the
+submit with a typed :class:`~paddle_tpu.serving.batcher.RequestShed` the
+caller sees immediately. Dequeue across tenants is weighted-fair (stride
+scheduling on served rows / weight), so one chatty tenant cannot starve
+the rest; within a tenant order stays FIFO (only head-of-line requests
+join a batch).
+
+Serving dtype: ``dtype="auto"`` consults the ``serving.dtype``
+``TunableChoice`` per (row-bucket, signature) -- measured like
+``conv2d.layout`` under ``PADDLE_TPU_TUNE=search``, cached decisions are a
+dict lookup -- and passes the winner to ``Predictor.run(dtype=...)``.
+``None``/``"float32"``/``"bfloat16"`` pin the path.
+
+Observability (all on the PR-9 ``/metrics`` endpoint, armed by
+``PADDLE_TPU_OBS_PORT``): ``serving_queue_depth`` / ``serving_in_flight``
+gauges, ``serving_batch_rows`` / ``serving_time_in_queue_seconds`` /
+``serving_request_seconds{tenant}`` (the latency-SLO) histograms,
+``serving_requests_total{tenant,outcome}`` + ``serving_shed_total
+{tenant,reason}`` counters, and ``serve_batch`` / ``serve_shed`` /
+``serve_drain`` journal events for ``tools/obs_report``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import journal as _journal
+from ..observability.metrics import REGISTRY as _OBS
+from ..tuning import choices as _choices
+from .batcher import (Batch, Clock, DynamicBatcher, MonotonicClock, Request,
+                      RequestShed, ServingError)
+
+__all__ = ["TenantQueue", "PredictorPool", "ServingDtype",
+           "BATCH_ROWS_BUCKETS"]
+
+#: serving_batch_rows histogram buckets: pow2 row buckets up to 512
+BATCH_ROWS_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+# --------------------------------------------------------------- fair queue --
+
+class TenantQueue:
+    """Bounded multi-tenant request queue with weighted fair dequeue.
+
+    - global bound: at most ``max_queue`` queued requests, else shed
+      ``queue_full``;
+    - per-tenant quota: at most ``quotas[tenant]`` queued requests per
+      tenant (``default_quota`` otherwise, None = unbounded up to the
+      global cap), else shed ``tenant_quota``;
+    - fairness: stride scheduling -- each tenant accrues virtual time
+      ``rows / weight`` as its rows are served and the lowest virtual time
+      goes next, so a weight-3 tenant gets ~3x the rows of a weight-1
+      tenant under contention. A tenant waking from idle resumes at the
+      current minimum active virtual time (no stored-up burst).
+    """
+
+    def __init__(self, max_queue: int = 128,
+                 quotas: Optional[Dict[str, int]] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 default_quota: Optional[int] = None,
+                 clock: Optional[Clock] = None):
+        if int(max_queue) < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.max_queue = int(max_queue)
+        self.quotas = dict(quotas or {})
+        self.weights = dict(weights or {})
+        self.default_quota = default_quota
+        self._clock = clock or MonotonicClock()
+        self._cond = threading.Condition()
+        self._tenants: Dict[str, List[Request]] = {}
+        self._vt: Dict[str, float] = {}
+        self._depth = 0
+        self._closed = False
+
+    def _weight(self, tenant: str) -> float:
+        w = float(self.weights.get(tenant, 1.0))
+        return w if w > 0 else 1.0
+
+    def depth(self, tenant: Optional[str] = None) -> int:
+        if tenant is None:
+            return self._depth
+        return len(self._tenants.get(tenant, ()))
+
+    def try_push(self, req: Request) -> Optional[str]:
+        """Admit ``req`` or return the shed reason (caller raises)."""
+        with self._cond:
+            if self._closed:
+                return "closed"
+            if self._depth >= self.max_queue:
+                return "queue_full"
+            quota = self.quotas.get(req.tenant, self.default_quota)
+            dq = self._tenants.get(req.tenant)
+            if quota is not None and dq is not None and len(dq) >= int(quota):
+                return "tenant_quota"
+            if quota is not None and dq is None and int(quota) <= 0:
+                return "tenant_quota"
+            if dq is None:
+                dq = self._tenants[req.tenant] = []
+            if not dq:
+                # waking from idle: resume at the active minimum so idle
+                # time is not banked into a starvation-inducing burst
+                active = [self._vt[t] for t, q in self._tenants.items()
+                          if q and t != req.tenant]
+                floor = min(active) if active else 0.0
+                self._vt[req.tenant] = max(
+                    self._vt.get(req.tenant, 0.0), floor)
+            dq.append(req)
+            self._depth += 1
+            self._cond.notify_all()
+            return None
+
+    def _fair_order(self) -> List[str]:
+        """Non-empty tenants, lowest virtual time first (name tiebreak)."""
+        return sorted((t for t, q in self._tenants.items() if q),
+                      key=lambda t: (self._vt.get(t, 0.0), t))
+
+    def _account(self, req: Request) -> None:
+        self._vt[req.tenant] = (self._vt.get(req.tenant, 0.0)
+                                + req.rows / self._weight(req.tenant))
+        self._depth -= 1
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def drain_pending(self) -> List[Request]:
+        """Remove and return everything queued (non-graceful close path)."""
+        with self._cond:
+            out = [r for t in sorted(self._tenants) for r in self._tenants[t]]
+            self._tenants.clear()
+            self._depth = 0
+            return out
+
+    # -- batcher protocol --------------------------------------------------
+    def pop_first(self, timeout: float) -> Optional[Request]:
+        deadline = self._clock.now() + timeout
+        with self._cond:
+            while True:
+                order = self._fair_order()
+                if order:
+                    req = self._tenants[order[0]].pop(0)
+                    self._account(req)
+                    return req
+                if self._closed:
+                    return None
+                remaining = deadline - self._clock.now()
+                if remaining <= 0:
+                    return None
+                self._clock.wait(self._cond, remaining)
+
+    def pop_compatible(self, sig, max_rows: int) -> Optional[Request]:
+        """Fair-order scan of head-of-line requests only (per-tenant FIFO
+        is never reordered to fill a batch)."""
+        with self._cond:
+            for t in self._fair_order():
+                head = self._tenants[t][0]
+                if head.sig == sig and head.rows <= max_rows:
+                    self._tenants[t].pop(0)
+                    self._account(head)
+                    return head
+            return None
+
+    def wait_for_more(self, timeout: float) -> None:
+        # called only after pop_compatible found nothing usable: wait for a
+        # push (an unconditional cond-wait -- returning early just because
+        # incompatible heads are queued would busy-spin the batcher)
+        with self._cond:
+            if not self._closed:
+                self._clock.wait(self._cond, timeout)
+
+
+# ------------------------------------------------------- serving.dtype knob --
+
+class ServingDtype(_choices.TunableChoice):
+    id = "serving.dtype"
+    doc = ("numeric path the serving tier runs a shape bucket in: "
+           "'float32' (native) or 'bfloat16' (half-precision pinned state "
+           "+ cast feeds, the AnalysisConfig.enable_bfloat16 path). "
+           "Measured per (row-bucket, feed-signature) like conv2d.layout; "
+           "default = the pool's configured dtype.")
+
+    def bucket(self, params: dict):
+        return {"rows": _choices.pow2_bucket(int(params["rows"])),
+                "sig": str(params["sig"])}
+
+    def candidates(self, params: dict) -> List[str]:
+        return ["float32", "bfloat16"]
+
+    def default(self, params: dict) -> str:
+        return params.get("configured") or "float32"
+
+    def bench(self, params: dict, candidate):
+        pred = params.get("predictor")
+        if pred is None:
+            return None   # offline tuning without a loaded model
+        import jax
+
+        from ..core.executor import trace_block
+        rows = _choices.pow2_bucket(int(params["rows"]))
+        feed = {name: np.zeros((rows,) + tuple(trail), dtype)
+                for name, trail, dtype in params["sig_parts"]}
+        feed = pred._cast_feed(feed, candidate)
+        # host copies: time_callable jits an isolated fn over its args
+        state = {k: np.asarray(v)
+                 for k, v in pred._state_for(candidate).items()}
+        block = pred.program.global_block()
+        fetches = list(pred.fetch_names)
+
+        def fn(state, inputs):
+            env = dict(state)
+            env.update(inputs)
+            trace_block(block, env, jax.random.PRNGKey(0))
+            return [env[n] for n in fetches]
+
+        return fn, (state, feed)
+
+
+if "serving.dtype" not in _choices.list_choices():
+    _choices.register_choice(ServingDtype())
+
+
+# -------------------------------------------------------------------- pool --
+
+class PredictorPool:
+    """N Predictors + N workers serving batched multi-tenant traffic."""
+
+    def __init__(self, model_dir: Optional[str] = None, *,
+                 size: int = 1,
+                 predictors: Optional[List[object]] = None,
+                 max_batch: int = 32, max_wait_ms: float = 2.0,
+                 max_queue: int = 128,
+                 quotas: Optional[Dict[str, int]] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 default_quota: Optional[int] = None,
+                 dtype: Optional[str] = None,
+                 model_filename=None, params_filename=None,
+                 clock: Optional[Clock] = None,
+                 idle_poll_s: float = 0.05):
+        if dtype not in (None, "auto", "float32", "bfloat16"):
+            raise ValueError(
+                f"pool dtype {dtype!r} invalid; use None, 'auto', "
+                f"'float32' or 'bfloat16'")
+        if predictors is None:
+            if model_dir is None:
+                raise ValueError("PredictorPool needs model_dir or "
+                                 "predictors=[...]")
+            if int(size) < 1:
+                raise ValueError("size must be >= 1")
+            from ..inference import Predictor
+            session_dtype = dtype if dtype in ("float32", "bfloat16") else None
+            predictors = [Predictor(model_dir, model_filename,
+                                    params_filename, dtype=session_dtype)
+                          for _ in range(int(size))]
+        self._dtype = dtype
+        self._predictors = list(predictors)
+        self._clock = clock or MonotonicClock()
+        self._idle_poll_s = float(idle_poll_s)
+        self._queue = TenantQueue(max_queue=max_queue, quotas=quotas,
+                                  weights=weights,
+                                  default_quota=default_quota,
+                                  clock=self._clock)
+        self._batcher = DynamicBatcher(max_batch=max_batch,
+                                       max_wait_ms=max_wait_ms,
+                                       clock=self._clock)
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        # accepted-but-unresolved requests: the drain condition. Queue depth
+        # + in-flight has a pop->mark window a drain poll could thread
+        # through; this counter moves atomically at submit and resolve.
+        self._pending = 0
+        self._draining = False
+        self._stopped = False
+        # the serving tier IS a long-lived server: arm the live /metrics
+        # endpoint if the operator exported PADDLE_TPU_OBS_PORT (one env
+        # read when unset -- same contract as the executor hook)
+        from ..observability import server as _server
+        _server.maybe_start()
+        self._g_depth = _OBS.gauge(
+            "serving_queue_depth", "queued serving requests")
+        self._g_inflight = _OBS.gauge(
+            "serving_in_flight", "serving requests dequeued, not yet done")
+        self._h_rows = _OBS.histogram(
+            "serving_batch_rows", "real rows per served batch",
+            buckets=BATCH_ROWS_BUCKETS)
+        self._h_queue_s = _OBS.histogram(
+            "serving_time_in_queue_seconds",
+            "submit -> batch-formation wait per request")
+        # per-tenant metric handles, resolved once: the registry's
+        # family+label lookup is cheap but not free, and the worker loop
+        # touches these per REQUEST at thousands of QPS
+        self._tenant_metrics: Dict[str, tuple] = {}
+        self._workers = [
+            threading.Thread(target=self._worker, args=(p,),
+                             name=f"serving-worker-{i}", daemon=True)
+            for i, p in enumerate(self._predictors)]
+        for t in self._workers:
+            t.start()
+
+    # -- client API --------------------------------------------------------
+    def submit(self, feed, tenant: str = "default") -> Request:
+        """Enqueue one request; returns a future (``.result(timeout)``).
+        Raises :class:`RequestShed` immediately when admission fails."""
+        req = Request(feed, tenant=tenant, t_submit=self._clock.now())
+        if self._draining or self._stopped:
+            self._shed(tenant, "closed")
+        reason = self._queue.try_push(req)
+        if reason is not None:
+            self._shed(tenant, reason)
+        with self._lock:
+            self._pending += 1
+        if self._stopped and not req.done():
+            # close() raced this submit between the _draining check and the
+            # push: the workers are gone, so resolve the request typed
+            # instead of stranding it
+            with self._lock:
+                self._pending -= 1
+            req.set_exception(RequestShed("closed", tenant))
+            self._shed(tenant, "closed")
+        self._g_depth.set(self._queue.depth())
+        self._metrics_for(tenant)[1].inc()
+        return req
+
+    def _metrics_for(self, tenant: str) -> tuple:
+        """(slo histogram, accepted, ok, error) handles for one tenant."""
+        m = self._tenant_metrics.get(tenant)
+        if m is None:
+            m = (_OBS.histogram(
+                    "serving_request_seconds",
+                    "end-to-end serving latency (submit -> response)",
+                    tenant=tenant),
+                 _OBS.counter("serving_requests_total",
+                              "serving requests by tenant and outcome",
+                              tenant=tenant, outcome="accepted"),
+                 _OBS.counter("serving_requests_total",
+                              "serving requests by tenant and outcome",
+                              tenant=tenant, outcome="ok"),
+                 _OBS.counter("serving_requests_total",
+                              "serving requests by tenant and outcome",
+                              tenant=tenant, outcome="error"))
+            self._tenant_metrics[tenant] = m
+        return m
+
+    def run(self, feed, tenant: str = "default",
+            timeout: Optional[float] = 60.0) -> List[np.ndarray]:
+        """Blocking submit: outputs ordered as the model's fetch_names,
+        byte-equal to a solo ``Predictor.run`` of the same feed."""
+        return self.submit(feed, tenant=tenant).result(timeout)
+
+    def _shed(self, tenant: str, reason: str):
+        _OBS.counter("serving_requests_total",
+                     "serving requests by tenant and outcome",
+                     tenant=tenant, outcome="shed").inc()
+        _OBS.counter("serving_shed_total",
+                     "shed serving requests by tenant and reason",
+                     tenant=tenant, reason=reason).inc()
+        _journal.emit({"event": "serve_shed", "tenant": tenant,
+                       "reason": reason})
+        raise RequestShed(reason, tenant)
+
+    # -- worker ------------------------------------------------------------
+    def _decide_dtype(self, batch: Batch, pred) -> Optional[str]:
+        if self._dtype != "auto":
+            return None if self._dtype is None else self._dtype
+        params = {"rows": batch.padded_rows, "sig": batch.sig,
+                  "sig_parts": batch.sig, "predictor": pred,
+                  "configured": "float32"}
+        try:
+            return _choices.decide("serving.dtype", params)
+        except Exception:
+            return "float32"   # a tuning surprise must never fail a batch
+
+    def _worker(self, pred) -> None:
+        import time
+        while True:
+            batch = self._batcher.form(self._queue,
+                                       timeout=self._idle_poll_s)
+            self._g_depth.set(self._queue.depth())
+            if batch is None:
+                if self._stopped and self._queue.depth() == 0:
+                    return
+                continue
+            with self._lock:
+                self._in_flight += len(batch.requests)
+            self._g_inflight.set(self._in_flight)
+            t_form = self._clock.now()
+            t0 = time.perf_counter()
+            try:
+                dt = self._decide_dtype(batch, pred)
+                outs = pred.run(batch.feed(), dtype=dt)
+                batch.scatter(outs)
+            except BaseException as e:   # a failed batch fails its requests
+                batch.fail(ServingError(f"batch execution failed: {e}"))
+                dt = None
+            finally:
+                with self._lock:
+                    self._in_flight -= len(batch.requests)
+                    self._pending -= len(batch.requests)
+                self._g_inflight.set(self._in_flight)
+            exec_ms = (time.perf_counter() - t0) * 1e3
+            tenants: Dict[str, int] = {}
+            ok = 0
+            t_done = self._clock.now()
+            for r in batch.requests:
+                tenants[r.tenant] = tenants.get(r.tenant, 0) + r.rows
+                self._h_queue_s.observe(max(0.0, t_form - r.t_submit))
+                m = self._metrics_for(r.tenant)
+                # the latency-SLO histogram: submit -> response, per tenant
+                m[0].observe(max(0.0, t_done - r.t_submit))
+                if r._error is None:
+                    ok += 1
+                    m[2].inc()
+                else:
+                    m[3].inc()
+            self._h_rows.observe(batch.rows)
+            _OBS.counter("serving_batches_total", "served batches").inc()
+            _journal.emit({
+                "event": "serve_batch", "requests": len(batch.requests),
+                "rows": batch.rows, "padded_rows": batch.padded_rows,
+                "exec_ms": round(exec_ms, 3), "dtype": dt or "native",
+                "ok": ok, "tenants": tenants})
+
+    def warmup(self, feed, buckets: Optional[List[int]] = None) -> int:
+        """Pre-compile the AOT executable for every pow2 row bucket (up to
+        ``max_batch``, or ``buckets``) on every predictor, in the dtype the
+        pool would serve that bucket in -- so no served request ever pays
+        an XLA compile. Returns the number of (predictor, bucket) pairs
+        warmed."""
+        probe = Request(feed)
+        if buckets is None:
+            cap = _choices.pow2_bucket(self._batcher.max_batch)
+            buckets = [1 << i for i in range(cap.bit_length())]
+        sizes = sorted({_choices.pow2_bucket(int(b)) for b in buckets})
+        warmed = 0
+        for b in sizes:
+            f = {k: np.repeat(v[:1], b, axis=0)
+                 for k, v in probe.feed.items()}
+            batch = Batch([Request(f)])
+            for pred in self._predictors:
+                pred.run(f, dtype=self._decide_dtype(batch, pred))
+                warmed += 1
+        return warmed
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    def queue_depth(self) -> int:
+        return self._queue.depth()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 60.0) -> None:
+        """Stop accepting work and shut the workers down.
+
+        ``drain=True`` (graceful): every already-accepted request is served
+        before workers exit -- zero in-flight, zero queued afterwards.
+        ``drain=False``: queued requests fail with a typed
+        ``RequestShed("closed")``; the batch currently executing still
+        completes.
+        """
+        import time
+        self._draining = True
+        if not drain:
+            dropped = self._queue.drain_pending()
+            for r in dropped:
+                r.set_exception(RequestShed("closed", r.tenant,
+                                            "pool closed without drain"))
+            with self._lock:
+                self._pending -= len(dropped)
+        deadline = (time.monotonic() + timeout) if timeout else None
+        while self._pending > 0 and not self._stopped:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pool drain incomplete after {timeout}s: "
+                    f"{self._queue.depth()} queued, "
+                    f"{self._in_flight} in flight")
+            time.sleep(0.002)
+        self._stopped = True
+        self._queue.close()
+        for t in self._workers:
+            t.join(timeout=5)
+        self._g_depth.set(0)
+        self._g_inflight.set(0)
+        _journal.emit({"event": "serve_drain", "drained": bool(drain)})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,430 @@
+"""Serving tier (paddle_tpu/serving/): continuous batching + multi-tenant
+Predictor pool.
+
+The load-bearing claims pinned here:
+
+- batched serving is BYTE-EQUAL to solo ``Predictor.run`` for every
+  request, across ragged arrivals and padded pow2 buckets;
+- admission control sheds with a typed error, never a hang; per-tenant
+  quotas bind; dequeue is weighted-fair; ``close()`` drains to zero
+  in-flight;
+- ``Predictor`` itself is safe under concurrent ``run()``: a cold
+  signature compiles exactly once and exactly one request is labeled cold;
+- the ``enable_bfloat16`` knob and the ``serving.dtype`` tunable actually
+  change the served dtype;
+- a process that never imports ``paddle_tpu.serving`` pays nothing:
+  ``Predictor.run`` opens no threads and no queues (the PR-1/PR-9
+  spy-guard pattern, in a subprocess so sibling tests can't pollute
+  ``sys.modules``).
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import AnalysisConfig, Predictor, \
+    create_paddle_predictor
+from paddle_tpu.observability import journal as obs_journal
+from paddle_tpu.observability.metrics import REGISTRY
+from paddle_tpu.serving import (Batch, DynamicBatcher, FakeClock,
+                                PredictorPool, Request, RequestShed,
+                                ServingError, SimpleQueue, TenantQueue)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_mlp(dirname, dim=8, seed=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [dim], "float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        prob = fluid.layers.softmax(fluid.layers.fc(h, 4))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], [prob], exe, main)
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("serve_model"))
+    _build_mlp(d)
+    return d
+
+
+class GatedFake:
+    """Predictor stand-in whose run() blocks on a gate: lets tests fill
+    queues deterministically. Row-wise: out = x * 2."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.started = threading.Event()
+        self.batches = []
+
+    def run(self, feed, dtype=None):
+        self.started.set()
+        assert self.gate.wait(30), "test gate never opened"
+        x = feed["x"]
+        self.batches.append(int(x.shape[0]))
+        return [x * 2.0]
+
+
+# ----------------------------------------------------------- byte equality --
+
+def test_batched_vs_solo_byte_equal_ragged(model_dir):
+    """Concurrent ragged arrivals coalesce into padded pow2 buckets and
+    every de-sliced output is byte-equal to solo Predictor.run."""
+    solo = Predictor(model_dir)
+    rng = np.random.RandomState(0)
+    rows = [1, 3, 2, 1, 5, 4, 1, 2]
+    feeds = [rng.randn(n, 8).astype("float32") for n in rows]
+    refs = [solo.run({"x": f})[0] for f in feeds]
+
+    obs_journal.clear()
+    pool = PredictorPool(model_dir, size=1, max_batch=8, max_wait_ms=25.0,
+                         max_queue=64)
+    try:
+        results = [None] * len(feeds)
+
+        def client(i):
+            results[i] = pool.run({"x": feeds[i]}, tenant=f"t{i % 3}",
+                                  timeout=120)
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(len(feeds))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        pool.close()
+    for i, (got, ref) in enumerate(zip(results, refs)):
+        assert got[0].dtype == ref.dtype and got[0].shape == ref.shape
+        assert got[0].tobytes() == ref.tobytes(), \
+            f"request {i} ({rows[i]} rows): batched != solo bytes"
+    # batching actually happened (this is the claim under test, not just
+    # N solo runs through a queue) and padding hit a pow2 bucket
+    batches = obs_journal.recent(event="serve_batch")
+    assert batches and any(e["requests"] > 1 for e in batches)
+    assert all(e["padded_rows"] == 1 << (e["rows"] - 1).bit_length()
+               or e["padded_rows"] == 1 for e in batches)
+
+
+def test_oversize_request_served_whole_and_byte_equal(model_dir):
+    """A request larger than max_batch is never split."""
+    solo = Predictor(model_dir)
+    x = np.random.RandomState(1).randn(21, 8).astype("float32")
+    ref = solo.run({"x": x})[0]
+    pool = PredictorPool(model_dir, size=1, max_batch=8, max_wait_ms=0.0)
+    try:
+        got = pool.run({"x": x}, timeout=120)
+    finally:
+        pool.close()
+    assert got[0].tobytes() == ref.tobytes()
+
+
+# -------------------------------------------------------- admission control --
+
+def test_shed_on_overload_typed_error():
+    """A full queue sheds immediately with a typed reason -- no hang."""
+    fake = GatedFake()
+    pool = PredictorPool(predictors=[fake], max_batch=4, max_wait_ms=0.0,
+                         max_queue=2)
+    try:
+        first = pool.submit({"x": np.ones((1, 4), "float32")})
+        assert fake.started.wait(10)       # worker holds it at the gate
+        q1 = pool.submit({"x": np.ones((1, 4), "float32")})
+        q2 = pool.submit({"x": np.ones((1, 4), "float32")})
+        t0 = time.monotonic()
+        with pytest.raises(RequestShed) as ei:
+            pool.submit({"x": np.ones((1, 4), "float32")})
+        assert time.monotonic() - t0 < 1.0     # immediate, not a timeout
+        assert ei.value.reason == "queue_full"
+        shed = REGISTRY.counter("serving_shed_total", tenant="default",
+                                reason="queue_full")
+        assert shed.value >= 1
+        fake.gate.set()
+        for r in (first, q1, q2):
+            r.result(timeout=30)
+    finally:
+        fake.gate.set()
+        pool.close()
+
+
+def test_tenant_quota_enforced():
+    """Tenant 'a' at quota sheds while 'b' is still admitted."""
+    fake = GatedFake()
+    pool = PredictorPool(predictors=[fake], max_batch=4, max_wait_ms=0.0,
+                         max_queue=16, quotas={"a": 1})
+    try:
+        blocker = pool.submit({"x": np.ones((1, 4), "float32")}, tenant="a")
+        assert fake.started.wait(10)
+        qa = pool.submit({"x": np.ones((1, 4), "float32")}, tenant="a")
+        with pytest.raises(RequestShed) as ei:
+            pool.submit({"x": np.ones((1, 4), "float32")}, tenant="a")
+        assert ei.value.reason == "tenant_quota" and ei.value.tenant == "a"
+        qb = pool.submit({"x": np.ones((1, 4), "float32")}, tenant="b")
+        fake.gate.set()
+        for r in (blocker, qa, qb):
+            r.result(timeout=30)
+    finally:
+        fake.gate.set()
+        pool.close()
+
+
+def test_weighted_fair_dequeue():
+    """Stride scheduling: weight 3:1 -> 3x the dequeued rows under
+    contention, per-tenant FIFO preserved."""
+    q = TenantQueue(max_queue=64, weights={"a": 3.0, "b": 1.0},
+                    clock=FakeClock())
+    for i in range(8):
+        for t in ("a", "b"):
+            assert q.try_push(Request({"x": np.full((1, 2), i, "float32")},
+                                      tenant=t)) is None
+    popped = [q.pop_first(timeout=0.01) for _ in range(12)]
+    tenants = [r.tenant for r in popped]
+    assert tenants.count("a") == 8 and tenants.count("b") == 4, tenants
+    for t in ("a", "b"):
+        vals = [float(r.feed["x"][0, 0]) for r in popped if r.tenant == t]
+        assert vals == sorted(vals)        # FIFO within the tenant
+
+
+def test_idle_tenant_resumes_at_active_floor():
+    """A tenant waking from idle must not bank a starvation burst."""
+    q = TenantQueue(max_queue=64, clock=FakeClock())
+    mk = lambda t: Request({"x": np.zeros((1, 2), "float32")}, tenant=t)
+    for _ in range(4):
+        q.try_push(mk("busy"))
+    for _ in range(3):
+        q.pop_first(timeout=0.01)          # busy accrues virtual time
+    q.try_push(mk("idle"))                 # wakes: floor = busy's vt
+    q.try_push(mk("busy"))
+    order = [q.pop_first(timeout=0.01).tenant for _ in range(3)]
+    # fair alternation from the floor, not an idle-tenant monopoly
+    assert order.count("idle") == 1
+
+
+# -------------------------------------------------------------------- drain --
+
+def test_drain_on_close_leaves_zero_in_flight():
+    fake = GatedFake()
+    pool = PredictorPool(predictors=[fake], max_batch=4, max_wait_ms=0.0,
+                         max_queue=64)
+    reqs = [pool.submit({"x": np.ones((1, 4), "float32")})
+            for _ in range(12)]
+    fake.gate.set()
+    pool.close(drain=True)
+    assert all(r.done() for r in reqs)
+    assert [r.result(0)[0].shape for r in reqs] == [(1, 4)] * 12
+    assert pool.in_flight == 0 and pool.queue_depth() == 0
+    assert not any(t.is_alive() for t in pool._workers)
+    with pytest.raises(RequestShed) as ei:     # closed pool sheds, typed
+        pool.submit({"x": np.ones((1, 4), "float32")})
+    assert ei.value.reason == "closed"
+
+
+def test_close_without_drain_sheds_queued():
+    fake = GatedFake()
+    pool = PredictorPool(predictors=[fake], max_batch=1, max_wait_ms=0.0,
+                         max_queue=64)
+    first = pool.submit({"x": np.ones((1, 4), "float32")})
+    assert fake.started.wait(10)           # worker holds `first` at the gate
+    queued = [pool.submit({"x": np.ones((1, 4), "float32")})
+              for _ in range(4)]
+    closer = threading.Thread(target=lambda: pool.close(drain=False))
+    closer.start()                         # drains the queue immediately...
+    time.sleep(0.2)
+    fake.gate.set()                        # ...then the held batch finishes
+    closer.join(30)
+    assert not closer.is_alive()
+    first.result(timeout=30)               # the executing batch completed
+    for r in queued:
+        with pytest.raises(RequestShed) as ei:
+            r.result(timeout=30)
+        assert ei.value.reason == "closed"
+
+
+# ------------------------------------------------------- predictor satellites --
+
+def test_predictor_concurrent_compile_once(model_dir):
+    """N threads racing a cold signature: one compile, one cold label,
+    byte-identical outputs (the _compiled/cold detection race fix)."""
+    pred = Predictor(model_dir)
+    REGISTRY.reset()
+    x = np.random.RandomState(2).randn(3, 8).astype("float32")
+    outs = [None] * 8
+
+    def worker(i):
+        outs[i] = pred.run({"x": x})[0]
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(pred._compiled) == 1
+    assert all(o.tobytes() == outs[0].tobytes() for o in outs)
+    fam = REGISTRY.get("predictor_executable_cache_total")
+    counts = {dict(k).get("outcome"): c.value for k, c in fam.items()}
+    assert counts == {"miss": 1.0, "hit": 7.0}, counts
+    lat = REGISTRY.get("predictor_request_seconds")
+    cold = {dict(k).get("cold"): c.count for k, c in lat.items()}
+    assert cold == {"true": 1, "false": 7}, cold
+
+
+def test_bf16_knob_changes_served_dtype(model_dir):
+    """AnalysisConfig.enable_bfloat16 is wired: pinned state and outputs
+    are bfloat16; the default path still serves float32 bytes."""
+    import jax.numpy as jnp
+    xv = np.random.RandomState(3).randn(2, 8).astype("float32")
+    base = Predictor(model_dir)
+    ref = base.run({"x": xv})[0]
+    assert ref.dtype == np.float32
+
+    cfg = AnalysisConfig(model_dir)
+    cfg.enable_bfloat16()
+    p16 = create_paddle_predictor(cfg)
+    out, = p16.run({"x": xv})
+    assert str(out.dtype) == "bfloat16"
+    assert all(str(jnp.asarray(v).dtype) == "bfloat16"
+               for v in p16._state_for("bfloat16").values())
+    # per-call override on a float32 session agrees with the bf16 session
+    over, = base.run({"x": xv}, dtype="bfloat16")
+    assert over.tobytes() == out.tobytes()
+    # and the float32 session path is untouched
+    again, = base.run({"x": xv})
+    assert again.tobytes() == ref.tobytes()
+    with pytest.raises(ValueError):
+        base.run({"x": xv}, dtype="float16")
+
+
+def test_serving_dtype_tunable_picks_the_path(model_dir):
+    """A cached serving.dtype=bfloat16 decision makes an auto-dtype pool
+    serve that bucket in bf16."""
+    from paddle_tpu.tuning import cache as tcache
+    from paddle_tpu.tuning.choices import get_choice
+    x = np.random.RandomState(4).randn(2, 8).astype("float32")
+    pool = PredictorPool(model_dir, size=1, max_batch=4, max_wait_ms=0.0,
+                         dtype="auto")
+    try:
+        out32 = pool.run({"x": x}, timeout=120)[0]
+        assert out32.dtype == np.float32       # default: configured f32
+        choice = get_choice("serving.dtype")
+        params = {"rows": 2, "sig": Request({"x": x}).sig}
+        tcache.CACHE.put(choice.key(params),
+                         {"choice": "serving.dtype", "winner": "bfloat16",
+                          "measured": True}, persist=False)
+        out16 = pool.run({"x": x}, timeout=120)[0]
+        assert str(out16.dtype) == "bfloat16"
+    finally:
+        pool.close()
+        tcache.CACHE.clear()
+
+
+# ------------------------------------------------------------ batcher units --
+
+def test_batcher_fake_clock_deadline():
+    """max_wait_ms is honored through the injected clock -- no real time
+    passes in this test."""
+    clock = FakeClock()
+    q = SimpleQueue(clock=clock)
+    q.push(Request({"x": np.zeros((1, 4), "float32")}))
+    b = DynamicBatcher(max_batch=8, max_wait_ms=7.0, clock=clock)
+    t0 = clock.now()
+    batch = b.form(q, timeout=0.01)
+    assert batch.rows == 1
+    assert clock.now() - t0 >= 7e-3 and clock.waits
+
+
+def test_batcher_signature_isolation_and_row_cap():
+    clock = FakeClock()
+    q = SimpleQueue(clock=clock)
+    q.push(Request({"x": np.zeros((2, 4), "float32")}))
+    q.push(Request({"x": np.zeros((2, 8), "float32")}))   # other signature
+    q.push(Request({"x": np.zeros((2, 4), "float32")}))
+    b = DynamicBatcher(max_batch=3, max_wait_ms=0.0, clock=clock).form(q)
+    # head-of-line (2,8) blocks nothing; the second (2,4) exceeds the
+    # 3-row cap so the batch closes at 2 rows
+    assert b.rows == 2 and q.depth() == 2
+
+
+def test_non_rowwise_fetch_fails_typed(tmp_path):
+    """A batch-reduced fetch cannot de-slice: typed ServingError, not
+    wrong bytes."""
+    d = str(tmp_path / "m")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 0
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [4], "float32")
+        m = fluid.layers.mean(fluid.layers.fc(x, 4))   # scalar fetch
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [m], exe, main)
+    pool = PredictorPool(d, size=1, max_batch=4, max_wait_ms=0.0)
+    try:
+        with pytest.raises(ServingError):
+            pool.run({"x": np.ones((2, 4), "float32")}, timeout=120)
+    finally:
+        pool.close()
+
+
+def test_request_validation_typed():
+    with pytest.raises(ServingError):
+        Request({})                                        # empty feed
+    with pytest.raises(ServingError):
+        Request({"x": np.float32(1.0)})                    # scalar feed
+    with pytest.raises(ServingError):
+        Request({"x": np.zeros((2, 3)), "y": np.zeros((3, 3))})  # ragged
+    b = Batch([Request({"x": np.zeros((2, 3), "float32")})])
+    b.scatter([np.zeros((), "float32")])
+    with pytest.raises(ServingError):
+        b.requests[0].result(0)
+
+
+# ------------------------------------------------------- zero-overhead guard --
+
+def test_zero_overhead_without_serving_import(model_dir):
+    """No serving import => Predictor.run spawns no threads, builds no
+    queues, and paddle_tpu never pulls paddle_tpu.serving in. Subprocess:
+    sibling tests legitimately import serving into this process."""
+    script = r"""
+import sys, threading
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.inference import Predictor
+
+assert "paddle_tpu.serving" not in sys.modules, "eager serving import"
+before = set(threading.enumerate())
+pred = Predictor(sys.argv[1])
+out, = pred.run({"x": np.ones((2, 8), "float32")})
+out, = pred.run({"x": np.ones((2, 8), "float32")})
+assert out.shape == (2, 4)
+new = {t for t in set(threading.enumerate()) - before if t.is_alive()}
+assert not new, f"Predictor.run spawned threads: {new}"
+assert "paddle_tpu.serving" not in sys.modules, "run() imported serving"
+print("GUARD-OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", script, model_dir],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "GUARD-OK" in r.stdout
+
+
+# ------------------------------------------------------------------ selftest --
+
+def test_serving_selftest_cli():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-m", "paddle_tpu.serving",
+                        "--selftest"], capture_output=True, text=True,
+                       timeout=600, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "serving selftest: OK" in r.stdout

@@ -239,6 +239,78 @@ def render_checkpoint(events: List[dict],
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------- serving --
+
+def render_serving(events: Optional[List[dict]],
+                   snapshot: Optional[dict] = None) -> str:
+    """Serving-tier activity (paddle_tpu/serving/): batch formation stats
+    and shed rate from ``serve_batch``/``serve_shed`` journal events,
+    queue depth and per-tenant request latency p50/p99 from the metrics
+    snapshot."""
+    lines = ["== Serving =="]
+    events = events or []
+    batches = [e for e in events if e.get("event") == "serve_batch"]
+    sheds = [e for e in events if e.get("event") == "serve_shed"]
+    fams = {f.get("name"): f for f in (snapshot or {}).get("families", [])}
+    if not batches and not sheds and "serving_requests_total" not in fams:
+        lines.append("idle: no serving activity (run a "
+                     "paddle_tpu.serving.PredictorPool or bench_inference "
+                     "--serve-qps)")
+        return "\n".join(lines)
+    if batches:
+        reqs = sum(int(e.get("requests") or 0) for e in batches)
+        rows = sum(int(e.get("rows") or 0) for e in batches)
+        padded = sum(int(e.get("padded_rows") or 0) for e in batches)
+        fill = f"{rows / padded:.1%}" if padded else "?"
+        lines.append(f"{len(batches)} batches serving {reqs} requests "
+                     f"({rows} rows, bucket fill {fill})")
+        lines.append("batch rows: " + _stats(
+            [float(e["rows"]) for e in batches
+             if e.get("rows") is not None]))
+        lines.append("batch exec_ms: " + _stats(
+            [e["exec_ms"] for e in batches
+             if e.get("exec_ms") is not None]))
+        dtypes = sorted({str(e.get("dtype")) for e in batches})
+        if dtypes not in (["native"], ["?"]):
+            lines.append(f"serving dtypes: {dtypes}")
+    accepted = shed_n = 0.0
+    for s in fams.get("serving_requests_total", {}).get("samples", []):
+        if s.get("labels", {}).get("outcome") == "accepted":
+            accepted += s.get("value", 0.0)
+        elif s.get("labels", {}).get("outcome") == "shed":
+            shed_n += s.get("value", 0.0)
+    if accepted or shed_n or sheds:
+        offered = accepted + shed_n
+        rate = f"{shed_n / offered:.1%}" if offered else "?"
+        lines.append(f"shed rate: {rate} ({shed_n:g} of {offered:g} "
+                     f"offered)")
+        by = {}
+        for e in sheds:
+            k = f"{e.get('tenant', '?')}/{e.get('reason', '?')}"
+            by[k] = by.get(k, 0) + 1
+        for k, n in sorted(by.items()):
+            lines.append(f"  shed {k}: x{n}")
+    for s in fams.get("serving_queue_depth", {}).get("samples", []):
+        lines.append(f"queue depth now: {s.get('value', 0.0):g}")
+    for s in fams.get("serving_in_flight", {}).get("samples", []):
+        if s.get("value"):
+            lines.append(f"in flight now: {s.get('value'):g}")
+    lat = fams.get("serving_request_seconds", {})
+    for s in lat.get("samples", []):
+        tenant = s.get("labels", {}).get("tenant", "?")
+        n = s.get("count", 0)
+        if not n:
+            continue
+        p50 = _hist_quantile(s.get("buckets", []), 0.5)
+        p99 = _hist_quantile(s.get("buckets", []), 0.99)
+        fmt = lambda v: ("?" if v is None else "inf" if math.isinf(v)
+                         else f"{v * 1e3:.4g}ms")
+        mean = s.get("sum", 0.0) / n
+        lines.append(f"  tenant {tenant}: n={n} mean={mean * 1e3:.4g}ms "
+                     f"p50<={fmt(p50)} p99<={fmt(p99)}")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- megastep --
 
 def _counter_total(snapshot: Optional[dict], name: str) -> Optional[float]:
@@ -518,6 +590,7 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_health(events))
         parts.append(render_resilience(events))
         parts.append(render_checkpoint(events, snapshot))
+        parts.append(render_serving(events, snapshot))
     if goodput:
         parts.append(render_goodput(events, snapshot))
     if fleet:
@@ -580,6 +653,13 @@ def selftest() -> int:
                              ("feed_wait", "dataset", 0.5)):
         reg.histogram("phase_seconds", phase=phase, cat=cat).observe(secs)
     reg.counter("straggler_total", rank="1").inc()
+    # serving section sources (paddle_tpu/serving/)
+    reg.gauge("serving_queue_depth").set(2)
+    reg.counter("serving_requests_total", tenant="a",
+                outcome="accepted").inc(9)
+    reg.counter("serving_requests_total", tenant="a", outcome="shed").inc()
+    for v in (0.004, 0.006, 0.009):
+        reg.histogram("serving_request_seconds", tenant="a").observe(v)
 
     events = [
         {"event": "run", "program": 1, "version": 0, "cache": "miss",
@@ -640,6 +720,12 @@ def selftest() -> int:
          "var": "w", "detail": "crc32 1, manifest says 2", "ts": 9.7},
         {"event": "ckpt_quarantine", "step": 8, "kind": "crc",
          "to": "ck/ckpt-8.corrupt", "reason": "crc mismatch", "ts": 9.8},
+        # serving section (continuous batching + Predictor pool)
+        {"event": "serve_batch", "requests": 3, "rows": 6, "padded_rows": 8,
+         "exec_ms": 4.5, "dtype": "float32", "ok": 3,
+         "tenants": {"a": 4, "b": 2}, "ts": 9.85},
+        {"event": "serve_shed", "tenant": "a", "reason": "tenant_quota",
+         "ts": 9.9},
     ]
 
     # a synthetic flight-recorder trace through the real exporter
@@ -704,6 +790,13 @@ def selftest() -> int:
                      "write ms/save (background)",
                      "CORRUPT chunk detected (crc)",
                      "QUARANTINE step 8 (crc) -> ck/ckpt-8.corrupt",
+                     # serving section
+                     "== Serving ==",
+                     "1 batches serving 3 requests (6 rows, bucket fill "
+                     "75.0%)",
+                     "shed rate: 10.0% (1 of 10 offered)",
+                     "shed a/tenant_quota: x1", "queue depth now: 2",
+                     "tenant a: n=3", "p99<=",
                      # goodput section (wall-clock ledger)
                      "== Goodput ==", "-> goodput",
                      "dispatch + fetch_sync", "lost compile",
@@ -726,6 +819,7 @@ def selftest() -> int:
         assert "healthy" in render_health([])
         assert "quiet" in render_resilience([])
         assert "quiet" in render_checkpoint([])
+        assert "idle" in render_serving([])
         assert "unfused" in render_megastep([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})

@@ -35,6 +35,7 @@ MODULES = [
     "paddle_tpu.tuning",
     "paddle_tpu.resilience",
     "paddle_tpu.observability",
+    "paddle_tpu.serving",
     "paddle_tpu.utils.checkpointer",
     "tools.ckpt_doctor",
 ]
