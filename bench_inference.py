@@ -178,14 +178,16 @@ def _open_loop_leg(pool, dim, qps, secs):
     """Open-loop generator: submissions follow the schedule t_i = i/qps
     regardless of completions (the arrival process of real traffic -- a
     closed loop would let a slow server throttle its own load). Returns
-    sustained QPS + latency percentiles over the leg."""
+    sustained QPS + latency percentiles + typed-outcome counts over the
+    leg."""
     import time
 
-    from paddle_tpu.serving import RequestShed
+    from paddle_tpu.serving import (RequestShed, RequestTimeout,
+                                    ServingError)
 
     x = np.random.RandomState(1).randn(1, dim).astype("float32")
     n = max(1, int(qps * secs))
-    futures, shed = [], 0
+    futures, shed, timeouts, errors = [], 0, 0, 0
     t0 = time.monotonic()
     for i in range(n):
         target = t0 + i / qps
@@ -202,8 +204,12 @@ def _open_loop_leg(pool, dim, qps, secs):
         try:
             f.result(timeout=60)
             ok_lats.append(f.t_done - f.t_submit)
-        except Exception:
+        except RequestTimeout:
+            timeouts += 1
+        except RequestShed:
             shed += 1
+        except ServingError:
+            errors += 1
     t_end = max((f.t_done for f in futures if f.t_done is not None),
                 default=time.monotonic())
     dt = max(t_end - t0, 1e-9)
@@ -212,7 +218,9 @@ def _open_loop_leg(pool, dim, qps, secs):
                    * 1e3 if ok_lats else float("inf"))
     return {"offered_qps": qps, "sustained_qps": len(ok_lats) / dt,
             "p50_ms": p(0.5), "p99_ms": p(0.99),
-            "shed": shed, "n_ok": len(ok_lats),
+            "shed": shed, "timeouts": timeouts, "errors": errors,
+            "n_ok": len(ok_lats), "n_offered": n,
+            "availability": len(ok_lats) / max(1, n),
             "shed_rate": shed / max(1, shed + len(ok_lats))}
 
 
@@ -235,7 +243,8 @@ def _scrape_serving_metrics():
 
 
 def serve_bench(qps=0.0, secs=4.0, pool_size=1, max_batch=64,
-                max_wait_ms=2.0, slo_ms=None, dim=256, emit=print):
+                max_wait_ms=2.0, slo_ms=None, dim=256, emit=print,
+                chaos=False):
     """The --serve-qps leg: serial baseline, then open-loop batched legs.
 
     ``qps=0`` auto-ramps offered load upward from 3x the serial QPS and
@@ -243,6 +252,13 @@ def serve_bench(qps=0.0, secs=4.0, pool_size=1, max_batch=64,
     ``qps>0`` runs exactly that offered load. ``slo_ms`` defaults to
     max(25ms, 2x the serial p99) -- the equal batch-1 latency budget both
     systems are judged under.
+
+    ``chaos=True`` adds a rung at the best clean offered load with
+    ``exc@serve_dispatch`` + ``hang@serve_dispatch`` faults armed
+    (seeded Bernoulli, so the run is reproducible), reporting
+    availability %, typed shed/timeout/error counts and p99 degradation
+    vs the clean rung -- the serving tier degrading instead of wedging,
+    measured.
     """
     import json as _json
     import os as _os
@@ -308,6 +324,27 @@ def serve_bench(qps=0.0, secs=4.0, pool_size=1, max_batch=64,
             scrape = _scrape_serving_metrics()
         finally:
             pool.close()
+
+        chaos_leg = None
+        if chaos:
+            # the chaos rung: same model, fresh pool (deadline-bounded so
+            # every casualty is typed), seeded exc + hang faults on the
+            # serving dispatch path at the best clean offered load
+            from paddle_tpu.resilience import faults as _faults
+            pool = PredictorPool(d, size=pool_size, max_batch=max_batch,
+                                 max_wait_ms=max_wait_ms, max_queue=2048,
+                                 default_deadline_ms=4.0 * budget)
+            try:
+                pool.warmup({"x": np.zeros((1, dim), "float32")})
+                _faults.install(
+                    "exc@serve_dispatch:prob=0.1:seed=7:times=0;"
+                    "hang@serve_dispatch:prob=0.02:seconds=0.01:seed=8"
+                    ":times=0")
+                chaos_leg = _open_loop_leg(pool, dim,
+                                           best["offered_qps"], secs)
+            finally:
+                _faults.clear()
+                pool.close(drain=True, drain_timeout=30.0)
     line({"metric": "serve_sustained_qps",
           "value": round(best["sustained_qps"], 1),
           "unit": f"batched requests/s (pool={pool_size}, "
@@ -328,6 +365,26 @@ def serve_bench(qps=0.0, secs=4.0, pool_size=1, max_batch=64,
               "value": 1 if scrape["live"] else 0,
               "unit": "serving series scrapeable on /metrics during run",
               "url": scrape["url"]})
+    if chaos_leg is not None:
+        line({"metric": "serve_chaos_availability_pct",
+              "value": round(100.0 * chaos_leg["availability"], 2),
+              "unit": f"ok requests / offered at "
+                      f"{round(chaos_leg['offered_qps'], 1)} qps under "
+                      f"exc@serve_dispatch(p=0.1) + "
+                      f"hang@serve_dispatch(p=0.02, 10ms)",
+              "n_ok": chaos_leg["n_ok"],
+              "n_offered": chaos_leg["n_offered"],
+              "shed": chaos_leg["shed"],
+              "timeouts": chaos_leg["timeouts"],
+              "typed_errors": chaos_leg["errors"],
+              "device_kind": kind})
+        line({"metric": "serve_chaos_p99_ms",
+              "value": round(chaos_leg["p99_ms"], 3),
+              "unit": "ms end-to-end on surviving requests under chaos",
+              "clean_p99_ms": round(best["p99_ms"], 3),
+              "degradation_x": round(
+                  chaos_leg["p99_ms"] / max(best["p99_ms"], 1e-9), 2),
+              "device_kind": kind})
     return results
 
 
@@ -352,13 +409,18 @@ def main(argv=None):
     ap.add_argument("--serve-wait-ms", type=float, default=2.0)
     ap.add_argument("--serve-slo-ms", type=float, default=None,
                     help="latency budget; default max(25, 2x serial p99)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="with --serve-qps: add a rung with seeded "
+                         "exc/hang faults at serve_dispatch, reporting "
+                         "availability and p99 degradation vs clean")
     args = ap.parse_args(argv)
     if args.serve_qps is not None:
         serve_bench(qps=args.serve_qps, secs=args.serve_secs,
                     pool_size=args.serve_pool,
                     max_batch=args.serve_max_batch,
                     max_wait_ms=args.serve_wait_ms,
-                    slo_ms=args.serve_slo_ms)
+                    slo_ms=args.serve_slo_ms,
+                    chaos=args.chaos)
         return
 
     _, kind = _peak()

@@ -99,6 +99,55 @@ class Predictor:
         # copy on device, built lazily on first use)
         self._states: Dict[Optional[str], Dict[str, object]] = {
             None: self._state}
+        #: weight generation served by this session (hot swap bumps it;
+        #: the pool tags journal events and /metrics with it)
+        self.model_version: int = 1
+
+    # -- hot swap ----------------------------------------------------------------------
+    def swap_state(self, new_state: Dict[str, object],
+                   validate_only: bool = False,
+                   model_version: Optional[int] = None) -> None:
+        """Atomically replace the pinned parameters with ``new_state``
+        (name -> array), keeping every compiled executable.
+
+        The executables take the state as a runtime argument, so a swap
+        whose arrays match the current shapes/dtypes needs NO recompile; a
+        mismatch is rejected typed before anything is touched.  The dict
+        reference flips atomically: a ``run()`` already past its state
+        lookup finishes on the old weights, the next call sees the new --
+        exactly the between-batches rotation the serving pool needs.
+        ``validate_only=True`` checks compatibility without swapping."""
+        import jax
+        missing = [n for n in self._state if n not in new_state]
+        if missing:
+            raise ValueError(
+                f"swap_state missing {len(missing)} parameter(s): "
+                f"{sorted(missing)[:5]}")
+        for n, cur in self._state.items():
+            new = np.asarray(new_state[n])
+            # metadata-only compare: np.asarray(cur) would d2h-transfer
+            # every pinned device array just to read its dtype
+            cur_shape = tuple(np.shape(cur))
+            cur_dtype = str(getattr(cur, "dtype", None)
+                            or np.asarray(cur).dtype)
+            if cur_shape != tuple(new.shape) or cur_dtype != str(new.dtype):
+                raise ValueError(
+                    f"swap_state parameter {n!r} is "
+                    f"{tuple(new.shape)}/{new.dtype}, current is "
+                    f"{cur_shape}/{cur_dtype}; "
+                    f"hot swap needs identical shapes and dtypes")
+        if validate_only:
+            return
+        pinned = {n: jax.device_put(np.asarray(new_state[n]))
+                  for n in self._state}
+        with self._lock:
+            self._state = pinned
+            # derived per-dtype cast copies rebuild lazily off the new state
+            self._states = {None: pinned}
+            if model_version is not None:
+                self.model_version = int(model_version)
+            else:
+                self.model_version += 1
 
     # -- serving dtype -----------------------------------------------------------------
     def _state_for(self, dtype: Optional[str]) -> Dict[str, object]:

@@ -22,19 +22,32 @@ Spec grammar (entries separated by ``;``)::
     kill:step=5:value=75           # ... or _exit(75) (clean preempt exit)
     corrupt:step=5:seed=1          # bit-flip a written checkpoint chunk
     truncate:step=5                # cut a written checkpoint chunk in half
+    exc@serve_dispatch:var=evil:times=0   # every batch with tenant 'evil'
+                                          # fails typed (breaker chaos)
+    hang@serve_hang:seconds=5             # wedge one serving worker
+    nan@serve_fetch:var=evil:times=0      # NaN that tenant's batch outputs
 
 Kinds: ``nan`` (also ``value=inf|-inf|<float>``), ``exc``, ``hang``,
 ``preempt``, ``kill`` (hard ``SIGKILL``/``os._exit`` of the current rank
 -- rank-death chaos for the elastic launcher; ``value=<int>`` picks the
 exit code), ``corrupt``, ``truncate``.  Sites: ``compile``, ``dispatch``,
-``fetch``, ``checkpoint_write`` (``nan`` ignores the site -- it corrupts
-the step's outputs/state by tensor name; ``corrupt``/``truncate`` only
-make sense at ``checkpoint_write``, where they damage the files the save
-just wrote -- see :func:`mutate_checkpoint`).  Keys: ``step`` (program
-step index, omit = every step), ``var``, ``times`` (total fires, default 1
-so a rolled-back step does not re-trip the same fault forever; 0 =
-unlimited), ``seconds`` (hang duration), ``prob`` + ``seed`` (seeded
-Bernoulli draw per match -- deterministic chaos), ``value``.
+``fetch``, ``checkpoint_write`` (``nan`` ignores the training site -- it
+corrupts the step's outputs/state by tensor name; ``corrupt``/``truncate``
+only make sense at ``checkpoint_write``, where they damage the files the
+save just wrote -- see :func:`mutate_checkpoint`), plus the serving-tier
+sites ``serve_dispatch`` (inside a batch: an ``exc`` here fails that
+batch's requests typed, a ``hang`` delays it), ``serve_fetch`` (between
+predictor run and de-slice; ``nan@serve_fetch`` overwrites the batch
+outputs -- see :func:`corrupt_serving`) and ``serve_hang`` (the worker
+loop outside any batch: a ``hang`` wedges the worker itself, an ``exc``
+kills the worker thread -- the crash-respawn chaos primitive).  Keys:
+``step`` (program step index / serving batch sequence, omit = every
+step), ``var`` (tensor name at training sites; at ``serve_*`` sites a
+TENANT name -- the fault only fires on batches carrying that tenant),
+``times`` (total fires, default 1 so a rolled-back step does not re-trip
+the same fault forever; 0 = unlimited), ``seconds`` (hang duration),
+``prob`` + ``seed`` (seeded Bernoulli draw per match -- deterministic
+chaos), ``value``.
 
 Every fire increments ``fault_injected_total{kind,site}`` and journals a
 ``fault`` event through the observability registry.  With nothing armed the
@@ -56,7 +69,11 @@ from ..observability.metrics import REGISTRY as _OBS
 ENV_VAR = "PADDLE_TPU_FAULTS"
 
 KINDS = ("nan", "exc", "hang", "preempt", "kill", "corrupt", "truncate")
-SITES = ("compile", "dispatch", "fetch", "checkpoint_write")
+SITES = ("compile", "dispatch", "fetch", "checkpoint_write",
+         "serve_dispatch", "serve_fetch", "serve_hang")
+#: sites fired from the serving tier (PredictorPool workers); ``var`` at
+#: these sites names a tenant, not a tensor
+SERVING_SITES = ("serve_dispatch", "serve_fetch", "serve_hang")
 _DEFAULT_SITE = {"nan": "fetch", "exc": "dispatch", "hang": "fetch",
                  "preempt": "dispatch", "kill": "dispatch",
                  "corrupt": "checkpoint_write",
@@ -118,12 +135,18 @@ class Fault:
     def spent(self) -> bool:
         return bool(self.times) and self.fired >= self.times
 
-    def matches(self, site: str, step: Optional[int]) -> bool:
+    def matches(self, site: str, step: Optional[int],
+                tags: Optional[Sequence[str]] = None) -> bool:
         if self.spent():
             return False
         if self.kind != "nan" and self.site != site:
             return False
         if self.step is not None and step != self.step:
+            return False
+        if (tags is not None and self.var is not None
+                and self.var not in tags):
+            # serving sites pass the batch's tenants as tags: var narrows
+            # the fault to batches carrying that tenant
             return False
         if self.prob < 1.0 and self._rng.random() >= self.prob:
             return False
@@ -248,13 +271,16 @@ def _record(f: Fault, site: str, step, program=None, var=None):
                    "step": step, "var": var, "program": program})
 
 
-def fire(site: str, step: Optional[int] = None, program=None):
+def fire(site: str, step: Optional[int] = None, program=None,
+         tags: Optional[Sequence[str]] = None):
     """Hook point: fire any armed exc/hang/preempt fault matching
-    ``site``/``step``. Called by Executor.run and Checkpointer.save only
-    when ``_active`` is non-empty.  Data kinds (nan/corrupt/truncate) have
-    their own hook points (corrupt_step / mutate_checkpoint)."""
+    ``site``/``step``. Called by Executor.run, Checkpointer.save and the
+    PredictorPool workers only when ``_active`` is non-empty.  Data kinds
+    (nan/corrupt/truncate) have their own hook points (corrupt_step /
+    corrupt_serving / mutate_checkpoint).  ``tags`` carries a serving
+    batch's tenant names so ``var=<tenant>`` can target one tenant."""
     for f in _active:
-        if f.kind in _DATA_KINDS or not f.matches(site, step):
+        if f.kind in _DATA_KINDS or not f.matches(site, step, tags):
             continue
         _record(f, site, step, program=program)
         if f.kind == "preempt":
@@ -306,7 +332,8 @@ def corrupt_step(step, fetch_names: Sequence[str], fetches, new_state: dict,
 
     fetches = list(fetches)
     for f in _active:
-        if f.kind != "nan" or not f.matches("fetch", step):
+        if f.kind != "nan" or f.site in SERVING_SITES \
+                or not f.matches("fetch", step):
             continue
         target = f.var
         if target is None:
@@ -337,6 +364,42 @@ def corrupt_step(step, fetch_names: Sequence[str], fetches, new_state: dict,
                     "detail": "var matched no fetch or written float "
                               "state var; fault not consumed"})
     return fetches, new_state
+
+
+def corrupt_serving(outputs, step: Optional[int] = None,
+                    tags: Optional[Sequence[str]] = None) -> list:
+    """Hook point: apply armed ``nan@serve_fetch`` faults to a serving
+    batch's outputs (called by the PredictorPool worker between predictor
+    run and de-slice, only when faults are armed).  ``var`` narrows the
+    fault to batches carrying that tenant (via ``tags``); the whole float
+    output is overwritten with the fault's value, so a health-checking
+    pool fails the batch typed and the breaker sees the poison."""
+    if not _active:
+        return list(outputs)
+    import numpy as np
+    outs = list(outputs)
+    for f in _active:
+        if f.kind != "nan" or f.site != "serve_fetch" \
+                or not f.matches("serve_fetch", step, tags):
+            continue
+        hit = False
+        for i, o in enumerate(outs):
+            arr = np.asarray(o)
+            if np.issubdtype(arr.dtype, np.floating) \
+                    or "float" in str(arr.dtype):
+                outs[i] = np.full(arr.shape, f.value, dtype=arr.dtype)
+                hit = True
+        if hit:
+            _record(f, "serve_fetch", step, var=f.var)
+        else:
+            f.missed += 1
+            if f.missed == 1:
+                _journal.emit({
+                    "event": "fault_miss", "kind": f.kind, "step": step,
+                    "var": f.var,
+                    "detail": "no float serving output to corrupt; "
+                              "fault not consumed"})
+    return outs
 
 
 def mutate_checkpoint(dirname, step: Optional[int] = None) -> List[dict]:

@@ -27,12 +27,14 @@ no threads and no queues (guard-tested).
 batcher drills plus a tiny-MLP pool round-trip (pinned by the test suite).
 """
 from .batcher import (Batch, Clock, DynamicBatcher, FakeClock,
-                      MonotonicClock, Request, RequestShed, ServingError,
-                      SimpleQueue, row_signature)
+                      MonotonicClock, Request, RequestShed, RequestTimeout,
+                      ServingError, SimpleQueue, row_signature)
+from .breaker import BreakerOpen, CircuitBreaker
 from .pool import PredictorPool, ServingDtype, TenantQueue
 
 __all__ = [
-    "Batch", "Clock", "DynamicBatcher", "FakeClock", "MonotonicClock",
-    "PredictorPool", "Request", "RequestShed", "ServingDtype",
-    "ServingError", "SimpleQueue", "TenantQueue", "row_signature",
+    "Batch", "BreakerOpen", "CircuitBreaker", "Clock", "DynamicBatcher",
+    "FakeClock", "MonotonicClock", "PredictorPool", "Request",
+    "RequestShed", "RequestTimeout", "ServingDtype", "ServingError",
+    "SimpleQueue", "TenantQueue", "row_signature",
 ]

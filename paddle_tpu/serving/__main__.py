@@ -99,6 +99,21 @@ def _selftest_batcher() -> None:
     assert order.count("a") == 6 and order.count("b") == 2, order
 
 
+def _build_mlp(d: str, seed: int = 11) -> None:
+    """Save a tiny 8->16->4 MLP inference model into ``d``."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [8], "float32")
+        y = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 4)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [y], exe, main)
+
+
 def _selftest_pool() -> None:
     """Stage 2: tiny-MLP pool round-trip, byte-equal to solo serving."""
     import tempfile
@@ -113,15 +128,7 @@ def _selftest_pool() -> None:
     from .pool import PredictorPool
 
     with tempfile.TemporaryDirectory() as d:
-        main, startup = fluid.Program(), fluid.Program()
-        main.random_seed = startup.random_seed = 11
-        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
-            x = fluid.data("x", [8], "float32")
-            y = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 4)
-        exe = fluid.Executor()
-        with fluid.scope_guard(fluid.Scope()):
-            exe.run(startup)
-            fluid.io.save_inference_model(d, ["x"], [y], exe, main)
+        _build_mlp(d)
 
         rng = np.random.RandomState(0)
         feeds = [rng.randn(n, 8).astype("float32") for n in (1, 2, 3, 1, 2)]
@@ -182,6 +189,249 @@ def selftest() -> int:
     return 0
 
 
+# ------------------------------------------------------------------- chaos --
+
+def chaos(secs: float = 2.0, qps: float = 400.0) -> int:
+    """The serving chaos leg: drive a real PredictorPool under injected
+    exc/hang/nan faults at open-loop load and assert the reliability
+    invariants (ISSUE 13 acceptance):
+
+    A. poisoned-tenant load: ``exc@serve_dispatch`` pinned to the poison
+       tenant + a transient ``hang@serve_dispatch`` on the clean one + one
+       ``exc@serve_hang`` worker-thread death -- every affected request
+       fails TYPED, the poison (tenant, signature) breaker opens and
+       fast-fails, the crashed worker respawns, and the clean tenant's
+       availability stays >= 99%;
+    B. mid-load hot swap: ``pool.swap(model_dir)`` under clean traffic --
+       zero shed, every output byte-equal to one of the two models solo,
+       everything submitted after the swap completes on the new weights;
+    C. deadline + wedged drain: ``hang@serve_hang`` wedges the only
+       worker; deadline'd requests resolve typed RequestTimeout anyway
+       (caller-side expiry) and ``close(drain_timeout=...)`` completes,
+       failing the rest typed (``serve_drain_timeout`` journaled).
+
+    Drain-to-zero holds at every phase boundary: zero stranded futures.
+    """
+    import json
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.inference import Predictor
+    from paddle_tpu.observability import journal as _journal
+    from paddle_tpu.resilience import faults
+    from .batcher import RequestShed, RequestTimeout, ServingError
+    from .breaker import BreakerOpen
+    from .pool import PredictorPool
+
+    def line(**kw):
+        print(json.dumps(kw), flush=True)
+
+    def harvest(futures):
+        """result() every future; returns {future: outcome} with outcome
+        "ok" or the typed error instance. Untyped errors are fatal."""
+        out = {}
+        for f in futures:
+            try:
+                f.result(timeout=30)
+                out[f] = "ok"
+            except ServingError as e:
+                out[f] = e
+            except TimeoutError:
+                raise AssertionError(
+                    "stranded future: request neither served nor failed "
+                    "typed within 30s")
+        return out
+
+    rng = np.random.RandomState(0)
+    clean_feed = {"x": rng.randn(1, 8).astype("float32")}
+    poison_feed = {"x": rng.randn(1, 9).astype("float32")}   # poisoned shape
+
+    with tempfile.TemporaryDirectory() as da, \
+            tempfile.TemporaryDirectory() as db:
+        _build_mlp(da, seed=11)
+        _build_mlp(db, seed=29)
+        ref_a = Predictor(da).run(clean_feed)[0]
+        ref_b = Predictor(db).run(clean_feed)[0]
+        assert ref_a.tobytes() != ref_b.tobytes(), "models must differ"
+
+        # ---- phase A: poisoned tenant + worker death under load --------
+        faults.clear()
+        _journal.clear()
+        faults.install("exc@serve_dispatch:var=poison:times=0;"
+                       "hang@serve_dispatch:var=clean:times=2:seconds=0.02;"
+                       "exc@serve_hang:times=1")
+        pool = PredictorPool(da, size=2, max_batch=8, max_wait_ms=2.0,
+                             max_queue=1024, default_deadline_ms=1000.0,
+                             breaker_threshold=3, breaker_backoff_s=0.5,
+                             check_outputs=True)
+        try:
+            pool.warmup(clean_feed)
+            n = max(20, int(qps * secs))
+            futures, breaker_fastfail, shed = [], 0, 0
+            owner = []
+            t0 = time.monotonic()
+            for i in range(n):
+                target = t0 + i / qps
+                d = target - time.monotonic()
+                if d > 0:
+                    time.sleep(d)
+                tenant = "poison" if i % 3 == 2 else "clean"
+                try:
+                    f = pool.submit(poison_feed if tenant == "poison"
+                                    else clean_feed, tenant=tenant)
+                    futures.append(f)
+                    owner.append(tenant)
+                except BreakerOpen:
+                    breaker_fastfail += 1
+                except RequestShed:
+                    shed += 1
+            outcomes = harvest(futures)
+            pool.close(drain=True, drain_timeout=10.0)
+            assert pool._pending == 0, \
+                f"drain-to-zero violated: {pool._pending} pending"
+            clean_ok = sum(1 for f, o in zip(futures, outcomes.values())
+                           if f.tenant == "clean" and o == "ok")
+            clean_n = sum(1 for t in owner if t == "clean")
+            availability = clean_ok / max(1, clean_n)
+            opened = [e for e in _journal.recent(event="serve_breaker")
+                      if e.get("to") == "open"
+                      and e.get("tenant") == "poison"]
+            crashes = _journal.recent(event="serve_worker_crash")
+            timeouts = sum(1 for o in outcomes.values()
+                           if isinstance(o, RequestTimeout))
+            line(phase="poisoned_tenant", submitted=n,
+                 accepted=len(futures), breaker_fastfail=breaker_fastfail,
+                 shed=shed, clean_availability=round(availability, 4),
+                 poison_breaker_opens=len(opened),
+                 worker_crashes=len(crashes), timeouts=timeouts)
+            assert availability >= 0.99, \
+                f"clean availability {availability:.1%} < 99% under " \
+                f"poisoned-tenant chaos"
+            assert opened, "poison breaker never opened"
+            assert breaker_fastfail > 0, "no breaker fast-fails observed"
+            assert crashes, "injected worker death not journaled"
+        finally:
+            faults.clear()
+
+        # ---- phase B: hot swap mid-load --------------------------------
+        _journal.clear()
+        pool = PredictorPool(da, size=2, max_batch=8, max_wait_ms=2.0,
+                             max_queue=1024)
+        try:
+            pool.warmup(clean_feed)
+            futures, t_submit = [], []
+            swap_done_at = [None]
+            n = max(40, int(qps * secs))
+            t0 = time.monotonic()
+            swapped = False
+            for i in range(n):
+                target = t0 + i / qps
+                d = target - time.monotonic()
+                if d > 0:
+                    time.sleep(d)
+                if not swapped and i >= n // 2:
+                    pool.swap(db)                     # mid-load, blocking
+                    swap_done_at[0] = time.monotonic()
+                    swapped = True
+                futures.append(pool.submit(clean_feed, tenant="clean"))
+                t_submit.append(time.monotonic())
+            outcomes = harvest(futures)
+            pool.close(drain=True, drain_timeout=10.0)
+            assert all(o == "ok" for o in outcomes.values()), \
+                "hot swap shed or failed requests"
+            n_old = n_new = 0
+            for f, ts in zip(futures, t_submit):
+                got = f._result[0].tobytes()
+                if got == ref_a.tobytes():
+                    n_old += 1
+                    assert ts <= swap_done_at[0], \
+                        "request submitted after swap served OLD weights"
+                elif got == ref_b.tobytes():
+                    n_new += 1
+                else:
+                    raise AssertionError(
+                        "output byte-equal to neither model: the swap "
+                        "tore a batch")
+            swaps = [e for e in _journal.recent(event="serve_swap")
+                     if e.get("outcome") == "ok"]
+            line(phase="hot_swap", requests=n, served_old=n_old,
+                 served_new=n_new, shed=0,
+                 model_version=pool.model_version,
+                 swap_ms=swaps[0].get("swap_ms") if swaps else None)
+            assert n_new > 0 and swaps and pool.model_version == 2
+        finally:
+            faults.clear()
+
+        # ---- phase C: wedged worker -- deadlines + drain timeout -------
+        _journal.clear()
+        faults.install("hang@serve_hang:times=1:seconds=30")
+        pool = PredictorPool(da, size=1, max_batch=8, max_wait_ms=2.0,
+                             max_queue=64)
+        try:
+            time.sleep(0.1)          # let the worker wedge on the hang
+            t0 = time.monotonic()
+            deadlined = [pool.submit(clean_feed, tenant="clean",
+                                     deadline_ms=80.0) for _ in range(3)]
+            outcomes = harvest(deadlined)
+            overshoot = max(max(0.0, f.t_done - f.deadline)
+                            for f in deadlined)
+            assert all(isinstance(o, RequestTimeout)
+                       for o in outcomes.values()), \
+                "wedged-worker requests must time out typed"
+            stuck = [pool.submit(clean_feed, tenant="clean")
+                     for _ in range(2)]
+            t_close = time.monotonic()
+            pool.close(drain=True, drain_timeout=0.4)
+            close_s = time.monotonic() - t_close
+            for f in stuck:
+                try:
+                    f.result(timeout=0)
+                    raise AssertionError("stuck request served by a "
+                                         "wedged worker?")
+                except RequestShed as e:
+                    assert e.reason == "closed"
+            drains = _journal.recent(event="serve_drain_timeout")
+            line(phase="wedged_drain", timeouts=len(deadlined),
+                 max_deadline_overshoot_ms=round(overshoot * 1e3, 1),
+                 close_seconds=round(close_s, 3),
+                 drain_timeout_journaled=bool(drains))
+            assert drains, "serve_drain_timeout not journaled"
+            assert close_s < 5.0, "close() wedged behind a stuck worker"
+            assert overshoot < 0.25, \
+                f"deadline overshoot {overshoot * 1e3:.0f}ms too large"
+        finally:
+            faults.clear()
+
+        # ---- nan poisoning: typed failure via check_outputs ------------
+        _journal.clear()
+        faults.install("nan@serve_fetch:var=nansy:times=0")
+        pool = PredictorPool(da, size=1, max_batch=8, max_wait_ms=0.0,
+                             max_queue=64, breaker_threshold=2,
+                             breaker_backoff_s=5.0, check_outputs=True)
+        try:
+            nan_typed = 0
+            fastfail = 0
+            for _ in range(6):
+                try:
+                    pool.run(clean_feed, tenant="nansy", timeout=30)
+                except BreakerOpen:
+                    fastfail += 1
+                except ServingError as e:
+                    assert "nonfinite" in str(e), e
+                    nan_typed += 1
+            pool.close(drain=True, drain_timeout=5.0)
+            line(phase="nan_poison", typed_failures=nan_typed,
+                 breaker_fastfail=fastfail)
+            assert nan_typed >= 2 and fastfail >= 1
+        finally:
+            faults.clear()
+
+    print("serving chaos: OK")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.serving",
@@ -191,9 +441,19 @@ def main(argv=None) -> int:
     ap.add_argument("--selftest", action="store_true",
                     help="hermetic fake-clock batcher drills + tiny-MLP "
                          "pool round-trip")
+    ap.add_argument("--chaos", action="store_true",
+                    help="drive a real pool under injected exc/hang/nan "
+                         "serving faults at load and assert the "
+                         "deadline/breaker/swap/drain invariants")
+    ap.add_argument("--secs", type=float, default=2.0,
+                    help="chaos: seconds of open-loop load per phase")
+    ap.add_argument("--qps", type=float, default=400.0,
+                    help="chaos: offered load per phase")
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.chaos:
+        return chaos(secs=args.secs, qps=args.qps)
     ap.print_help()
     return 0
 

@@ -45,8 +45,9 @@ import numpy as np
 from ..tuning.choices import pow2_bucket
 
 __all__ = [
-    "ServingError", "RequestShed", "Clock", "MonotonicClock", "FakeClock",
-    "Request", "Batch", "DynamicBatcher", "SimpleQueue", "row_signature",
+    "ServingError", "RequestShed", "RequestTimeout", "Clock",
+    "MonotonicClock", "FakeClock", "Request", "Batch", "DynamicBatcher",
+    "SimpleQueue", "row_signature",
 ]
 
 
@@ -58,7 +59,9 @@ class RequestShed(ServingError):
     """Admission control rejected the request (typed, never a hang).
 
     ``reason`` is one of ``"queue_full"`` (global bound), ``"tenant_quota"``
-    (per-tenant bound), ``"closed"`` (pool draining or closed).
+    (per-tenant bound), ``"closed"`` (pool draining or closed),
+    ``"breaker_open"`` (the (tenant, signature) circuit breaker is open --
+    see :class:`~paddle_tpu.serving.breaker.BreakerOpen`).
     """
 
     def __init__(self, reason: str, tenant: str, detail: str = ""):
@@ -67,6 +70,20 @@ class RequestShed(ServingError):
         super().__init__(
             f"request shed ({reason}) for tenant {tenant!r}"
             + (f": {detail}" if detail else ""))
+
+
+class RequestTimeout(ServingError):
+    """The request's deadline expired before it was served (typed, never a
+    hang). Expired requests are evicted before batch assembly, so a dead
+    request never occupies batch rows."""
+
+    def __init__(self, tenant: str, waited_ms: float, deadline_ms: float):
+        self.tenant = tenant
+        self.waited_ms = float(waited_ms)
+        self.deadline_ms = float(deadline_ms)
+        super().__init__(
+            f"request deadline expired for tenant {tenant!r}: waited "
+            f"{waited_ms:.1f}ms of a {deadline_ms:.1f}ms budget")
 
 
 # ------------------------------------------------------------------ clocks --
@@ -126,11 +143,15 @@ class Request:
     """One in-flight serving request: a future the batcher fulfills.
 
     ``feed`` values are converted to numpy on construction; every feed must
-    carry the same leading (row) dimension.
+    carry the same leading (row) dimension.  ``deadline`` is an absolute
+    timestamp on the owning pool's clock (None = no deadline); an expired
+    request is evicted before batch assembly and resolved with a typed
+    :class:`RequestTimeout`.
     """
 
     def __init__(self, feed: Dict[str, object], tenant: str = "default",
-                 t_submit: float = 0.0):
+                 t_submit: float = 0.0,
+                 deadline: Optional[float] = None):
         self.tenant = str(tenant)
         self.feed: Dict[str, np.ndarray] = {
             k: np.asarray(v) for k, v in dict(feed).items()}
@@ -152,30 +173,70 @@ class Request:
         self.rows: int = int(rows)
         self.sig = row_signature(self.feed)
         self.t_submit = float(t_submit)
+        self.deadline = None if deadline is None else float(deadline)
+        #: times a sig-compatible batch bypassed this head-of-line request
+        #: because it was oversize for the remaining batch space; at the
+        #: queue's ``max_head_bypass`` the request is marked ``solo`` and
+        #: the batcher dispatches it alone (starvation bound)
+        self.bypassed: int = 0
+        self.solo: bool = False
+        #: pool seams (set by PredictorPool.submit): the pool's clock and
+        #: its typed-expiry callback, so ``result()`` can resolve a
+        #: deadline even when every worker is wedged
+        self._clock: Optional[Clock] = None
+        self._expire_cb = None
         self._done = threading.Event()
         self._result: Optional[List[np.ndarray]] = None
         self._error: Optional[BaseException] = None
         #: monotonic fulfillment time (stamped at resolve, not at result()
         #: -- open-loop benchmarks read exact per-request latency off it)
         self.t_done: Optional[float] = None
+        self._resolve_lock = threading.Lock()
 
     # future protocol ------------------------------------------------------
     def done(self) -> bool:
         return self._done.is_set()
 
-    def set_result(self, outputs: List[np.ndarray]) -> None:
+    def set_result(self, outputs: List[np.ndarray]) -> bool:
+        """Resolve with a value. First writer wins (a request already
+        resolved -- e.g. by a deadline expiry racing a late worker -- is
+        left untouched). Returns whether this call resolved the future."""
         import time
-        self._result = outputs
-        self.t_done = time.monotonic()
-        self._done.set()
+        with self._resolve_lock:
+            if self._done.is_set():
+                return False
+            self._result = outputs
+            self.t_done = time.monotonic()
+            self._done.set()
+            return True
 
-    def set_exception(self, exc: BaseException) -> None:
+    def set_exception(self, exc: BaseException) -> bool:
+        """Resolve with an error; first writer wins (see set_result)."""
         import time
-        self._error = exc
-        self.t_done = time.monotonic()
-        self._done.set()
+        with self._resolve_lock:
+            if self._done.is_set():
+                return False
+            self._error = exc
+            self.t_done = time.monotonic()
+            self._done.set()
+            return True
 
     def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        if (self.deadline is not None and self._expire_cb is not None
+                and not self._done.is_set() and self._clock is not None):
+            # deadline-aware wait: if the deadline passes while every
+            # worker is wedged (nothing left to reap the queue), the
+            # caller's own wait resolves the future typed -- a request can
+            # never outlive its deadline just because the pool did
+            remaining = self.deadline - self._clock.now()
+            wait1 = remaining if timeout is None else min(remaining, timeout)
+            if wait1 > 0:
+                self._done.wait(wait1)
+            if (not self._done.is_set()
+                    and self._clock.now() >= self.deadline):
+                self._expire_cb(self)
+            if timeout is not None:
+                timeout = max(0.0, timeout - max(0.0, wait1))
         if not self._done.wait(timeout):
             raise TimeoutError(
                 f"serving request (tenant {self.tenant!r}, {self.rows} "
@@ -199,6 +260,10 @@ class Batch:
         #: rows actually dispatched: the pow2 shape bucket, so ragged
         #: arrival patterns reuse a handful of AOT executables
         self.padded_rows = pow2_bucket(self.rows)
+        #: the error this batch failed with, if any (set by fail() --
+        #: including scatter's internal non-row-wise rejection, so the
+        #: pool's breaker sees every failure mode)
+        self.failed_exc: Optional[BaseException] = None
 
     def feed(self) -> Dict[str, np.ndarray]:
         """Concatenate per-request rows (request order) and pad to the row
@@ -215,28 +280,33 @@ class Batch:
                       else np.concatenate(parts, axis=0))
         return out
 
-    def scatter(self, outputs: Sequence[np.ndarray]) -> None:
+    def scatter(self, outputs: Sequence[np.ndarray]) -> int:
         """De-slice batch outputs back per request (byte-equal to solo
-        serving) and resolve every request's future."""
+        serving) and resolve every request's future. Returns the number of
+        futures THIS call resolved (a request already resolved -- e.g. by
+        a deadline racing the batch -- keeps its first resolution)."""
         outs = [np.asarray(o) for o in outputs]
         for i, o in enumerate(outs):
             if o.ndim == 0 or int(o.shape[0]) != self.padded_rows:
-                self.fail(ServingError(
+                return self.fail(ServingError(
                     f"fetch #{i} has shape {tuple(o.shape)}, not "
                     f"{self.padded_rows} leading rows: the model is not "
                     f"row-wise (a batch-reduced fetch cannot be de-sliced "
                     f"per request); serve it through Predictor.run directly"))
-                return
         off = 0
+        resolved = 0
         for r in self.requests:
-            r.set_result([np.ascontiguousarray(o[off:off + r.rows])
-                          for o in outs])
+            if r.set_result([np.ascontiguousarray(o[off:off + r.rows])
+                             for o in outs]):
+                resolved += 1
             off += r.rows
+        return resolved
 
-    def fail(self, exc: BaseException) -> None:
-        for r in self.requests:
-            if not r.done():
-                r.set_exception(exc)
+    def fail(self, exc: BaseException) -> int:
+        """Resolve every not-yet-done request with ``exc``; returns how
+        many futures this call resolved."""
+        self.failed_exc = exc
+        return sum(1 for r in self.requests if r.set_exception(exc))
 
 
 # ------------------------------------------------------------------- queues --
@@ -320,6 +390,10 @@ class DynamicBatcher:
         first = queue.pop_first(timeout)
         if first is None:
             return None
+        if first.solo:
+            # bypassed past the queue's cap: dispatch alone, immediately --
+            # waiting for company is what starved it in the first place
+            return Batch([first])
         reqs = [first]
         rows = first.rows
         deadline = self._clock.now() + self.max_wait_ms / 1e3

@@ -1,0 +1,678 @@
+"""Serving-tier resilience (ISSUE 13): request deadlines, worker-crash
+recovery, circuit breaking, hot model swap, drain timeout, head-bypass
+starvation bound, and the serving chaos CLI.
+
+The load-bearing claims pinned here:
+
+- a request can NEVER outlive its deadline silently: expired requests are
+  evicted before batch assembly (they occupy no batch rows) and resolve
+  with a typed ``RequestTimeout`` -- in-queue, mid-wait, and even with
+  every worker wedged (caller-side expiry);
+- a predictor exception fails only its batch (typed ``ServingError``) and
+  an unexpected worker-thread death respawns the worker -- the pool never
+  silently shrinks;
+- K consecutive batch failures on one (tenant, signature) open its
+  circuit breaker: typed ``BreakerOpen`` fast-fail, half-open probe after
+  the backoff, close on probe success -- all hermetic under ``FakeClock``;
+- ``pool.swap()`` verifies staged weights against the PR-8 checksum
+  manifests, rotates predictors between batches (in-flight batches finish
+  on the OLD weights), and is byte-equal to solo serving of the new model;
+- ``close(drain_timeout=...)`` completes under a wedged worker, failing
+  the remainder typed (``serve_drain_timeout`` journaled);
+- the chaos CLI (``python -m paddle_tpu.serving --chaos``) passes, and
+  with faults disarmed the serving hot path calls no fault hooks and
+  opens no files (subprocess guard).
+
+Hermetic tier: everything driven through ``FakeClock`` +
+``PredictorPool(start_workers=False)`` uses zero wall-clock sleeps.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import journal as obs_journal
+from paddle_tpu.observability.metrics import REGISTRY
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (Batch, BreakerOpen, CircuitBreaker,
+                                DynamicBatcher, FakeClock, PredictorPool,
+                                Request, RequestShed, RequestTimeout,
+                                ServingError, TenantQueue)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakePredictor:
+    """Row-wise out = x * mult stand-in: records batch sizes, can be told
+    to fail, and supports the hot-swap protocol (state = {"mult": v})."""
+
+    def __init__(self, mult=2.0):
+        self.mult = float(mult)
+        self.batches = []
+        self.fail_next = 0
+        self.model_version = 1
+
+    def run(self, feed, dtype=None):
+        if self.fail_next:
+            self.fail_next -= 1
+            raise RuntimeError("predictor boom")
+        x = feed["x"]
+        self.batches.append(int(x.shape[0]))
+        return [x * self.mult]
+
+    def swap_state(self, state, validate_only=False, model_version=None):
+        if "mult" not in state:
+            raise ValueError("swap_state missing parameter 'mult'")
+        if validate_only:
+            return
+        self.mult = float(np.asarray(state["mult"]))
+        if model_version is not None:
+            self.model_version = int(model_version)
+
+
+class GatedFake:
+    """Predictor whose run() blocks on a gate (wedged-worker drills)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.started = threading.Event()
+
+    def run(self, feed, dtype=None):
+        self.started.set()
+        assert self.gate.wait(30), "test gate never opened"
+        return [feed["x"] * 2.0]
+
+    def swap_state(self, state, validate_only=False, model_version=None):
+        if validate_only:
+            return
+        if model_version is not None:
+            self.model_version = int(model_version)
+
+
+def hermetic_pool(preds, clock, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 0.0)
+    kw.setdefault("max_queue", 64)
+    return PredictorPool(predictors=preds, clock=clock,
+                         start_workers=False, **kw)
+
+
+def feed(rows=1, dim=4, fill=1.0):
+    return {"x": np.full((rows, dim), fill, "float32")}
+
+
+# ---------------------------------------------------------------- deadlines --
+
+def test_deadline_expiry_in_queue_hermetic():
+    """A queued request whose deadline passes is reaped on the next queue
+    op: typed RequestTimeout, no batch rows, metrics + journal signal."""
+    clock = FakeClock()
+    fake = FakePredictor()
+    pool = hermetic_pool([fake], clock, default_deadline_ms=50.0)
+    obs_journal.clear()
+    c0 = REGISTRY.counter("serving_timeout_total", tenant="t").value
+    r = pool.submit(feed(), tenant="t")
+    assert r.deadline == pytest.approx(0.05)
+    clock.advance(0.06)                      # past the deadline, in queue
+    assert pool._serve_once(0, fake) is None   # reaped, nothing dispatched
+    assert fake.batches == []
+    with pytest.raises(RequestTimeout) as ei:
+        r.result(timeout=0)
+    assert ei.value.tenant == "t" and ei.value.deadline_ms == 50.0
+    assert pool._pending == 0
+    assert REGISTRY.counter("serving_timeout_total",
+                            tenant="t").value == c0 + 1
+    evs = obs_journal.recent(event="serve_timeout")
+    assert evs and evs[-1]["tenant"] == "t"
+
+
+def test_deadline_mid_wait_evicted_before_dispatch():
+    """A request that expires while the batcher waits for company is
+    pruned at batch assembly -- within one max_wait tick, zero rows."""
+    clock = FakeClock()
+    fake = FakePredictor()
+    pool = hermetic_pool([fake], clock, max_wait_ms=100.0)
+    r = pool.submit(feed(), deadline_ms=20.0)
+    # form() pops it, waits the full 100ms tick on the fake clock (no
+    # compatible company arrives), then the pre-dispatch prune evicts it
+    assert pool._serve_once(0, fake) is None
+    assert fake.batches == []
+    with pytest.raises(RequestTimeout):
+        r.result(timeout=0)
+    # never outlived its deadline by more than one max_wait_ms tick
+    assert clock.now() - r.deadline <= 0.100 + 1e-9
+    assert pool._pending == 0
+
+
+def test_expired_request_never_occupies_batch_rows():
+    """Dead and live requests interleaved: the dispatched batch carries
+    only live rows."""
+    clock = FakeClock()
+    fake = FakePredictor()
+    pool = hermetic_pool([fake], clock)
+    dead = pool.submit(feed(rows=2), tenant="a", deadline_ms=10.0)
+    clock.advance(0.02)
+    live = pool.submit(feed(rows=3), tenant="b")
+    batch = pool._serve_once(0, fake)
+    assert batch is not None and fake.batches == [4]     # 3 rows -> pow2 4
+    assert [r.rows for r in batch.requests] == [3]
+    with pytest.raises(RequestTimeout):
+        dead.result(timeout=0)
+    assert live.result(timeout=0)[0].shape == (3, 4)
+    assert pool._pending == 0
+
+
+def test_caller_side_expiry_when_worker_wedged():
+    """Every worker wedged: the caller blocked in result() still gets a
+    typed RequestTimeout at the deadline -- a request cannot outlive its
+    deadline just because the pool did."""
+    fake = GatedFake()
+    pool = PredictorPool(predictors=[fake], max_batch=1, max_wait_ms=0.0)
+    try:
+        blocker = pool.submit(feed())
+        assert fake.started.wait(10)           # worker held at the gate
+        r = pool.submit(feed(), deadline_ms=60.0)
+        t0 = time.monotonic()
+        with pytest.raises(RequestTimeout):
+            r.result(timeout=10)
+        waited = time.monotonic() - t0
+        assert waited < 5.0, "expiry must come from the deadline, not " \
+                             "the result() timeout"
+        assert pool._pending >= 1              # blocker still in flight
+        fake.gate.set()
+        blocker.result(timeout=30)
+    finally:
+        fake.gate.set()
+        pool.close()
+    assert pool._pending == 0
+
+
+# ------------------------------------------------------ worker crash/respawn --
+
+def test_predictor_exception_fails_only_that_batch():
+    """One failing batch: typed ServingError for its requests, the pool
+    keeps serving the next."""
+    fake = FakePredictor()
+    fake.fail_next = 1
+    pool = PredictorPool(predictors=[fake], max_batch=4, max_wait_ms=0.0)
+    try:
+        with pytest.raises(ServingError, match="predictor boom"):
+            pool.run(feed(), timeout=30)
+        out, = pool.run(feed(fill=3.0), timeout=30)
+        assert np.allclose(out, 6.0)
+    finally:
+        pool.close()
+
+
+def test_worker_thread_death_respawns():
+    """exc@serve_hang kills the worker OUTSIDE any batch: the crash is
+    journaled + counted, the worker respawns, and serving continues."""
+    obs_journal.clear()
+    c0 = REGISTRY.counter("serving_worker_crash_total").value
+    faults.clear()
+    faults.install("exc@serve_hang:times=1")
+    fake = FakePredictor()
+    pool = PredictorPool(predictors=[fake], max_batch=4, max_wait_ms=0.0)
+    try:
+        out, = pool.run(feed(fill=2.0), timeout=30)   # respawned worker
+        assert np.allclose(out, 4.0)
+        crashes = obs_journal.recent(event="serve_worker_crash")
+        assert crashes and "TransientFault" in crashes[-1]["error"]
+        assert REGISTRY.counter("serving_worker_crash_total").value \
+            == c0 + 1
+        assert any(t.is_alive() for t in pool._workers)
+    finally:
+        faults.clear()
+        pool.close()
+    assert pool._pending == 0
+
+
+def test_exc_at_serve_dispatch_fails_batch_typed():
+    """exc@serve_dispatch INSIDE the batch: that batch's requests fail
+    typed; the fault consumed, the next batch serves fine."""
+    faults.clear()
+    faults.install("exc@serve_dispatch:times=1")
+    fake = FakePredictor()
+    pool = PredictorPool(predictors=[fake], max_batch=4, max_wait_ms=0.0)
+    try:
+        with pytest.raises(ServingError, match="UNAVAILABLE"):
+            pool.run(feed(), timeout=30)
+        assert fake.batches == []              # fault fired before run()
+        pool.run(feed(), timeout=30)
+        assert fake.batches == [1]
+    finally:
+        faults.clear()
+        pool.close()
+
+
+# ------------------------------------------------------------------ breaker --
+
+def test_breaker_unit_cycle_hermetic():
+    clock = FakeClock()
+    seen = []
+    br = CircuitBreaker(threshold=2, backoff_s=1.0, backoff_max_s=4.0,
+                        clock=clock,
+                        on_transition=lambda k, o, n, e: seen.append((o, n)))
+    k = ("t", "sig")
+    assert br.allow(k) == (True, "closed", 0.0)
+    br.record_failure(k)
+    assert br.state(k) == "closed"             # 1 of 2
+    br.record_failure(k)
+    assert br.state(k) == "open" and seen == [("closed", "open")]
+    ok, state, retry = br.allow(k)
+    assert not ok and state == "open" and retry == pytest.approx(1.0)
+    clock.advance(1.1)
+    ok, state, _ = br.allow(k)                 # half-open probe admitted
+    assert ok and state == "half_open"
+    ok, state, _ = br.allow(k)                 # second concurrent: denied
+    assert not ok and state == "half_open"
+    br.record_success(k)                       # probe succeeded
+    assert br.state(k) == "closed"
+    assert seen[-1] == ("half_open", "closed")
+    # re-trip, fail the probe: doubled backoff
+    br.record_failure(k)
+    br.record_failure(k)
+    clock.advance(1.1)
+    assert br.allow(k)[0]
+    br.record_failure(k)
+    assert br.state(k) == "open"
+    assert not br.allow(k)[0]
+    clock.advance(1.5)                         # 1.0s was enough before...
+    assert not br.allow(k)[0]                  # ...but backoff doubled to 2
+    clock.advance(0.6)
+    assert br.allow(k)[0]
+
+
+def test_breaker_pool_fastfail_and_recovery_hermetic():
+    """Pool-level cycle under FakeClock: K consecutive batch failures open
+    the (tenant, sig) breaker, submits fast-fail BreakerOpen, the
+    half-open probe closes it, and the state is journaled + gauged."""
+    clock = FakeClock()
+    fake = FakePredictor()
+    fake.fail_next = 99
+    pool = hermetic_pool([fake], clock, breaker_threshold=2,
+                         breaker_backoff_s=1.0)
+    obs_journal.clear()
+    for _ in range(2):
+        r = pool.submit(feed(), tenant="evil")
+        pool._serve_once(0, fake)
+        with pytest.raises(ServingError):
+            r.result(timeout=0)
+    # open: typed fast-fail at submit, no queue entry
+    with pytest.raises(BreakerOpen) as ei:
+        pool.submit(feed(), tenant="evil")
+    assert ei.value.reason == "breaker_open"
+    assert pool.queue_depth() == 0 and pool._pending == 0
+    # other tenants with the same signature are untouched
+    fake.fail_next = 0
+    ok_req = pool.submit(feed(fill=5.0), tenant="good")
+    pool._serve_once(0, fake)
+    assert np.allclose(ok_req.result(timeout=0)[0], 10.0)
+    # after the backoff: one probe admitted, success closes the breaker
+    clock.advance(1.1)
+    probe = pool.submit(feed(fill=2.0), tenant="evil")
+    pool._serve_once(0, fake)
+    assert np.allclose(probe.result(timeout=0)[0], 4.0)
+    pool.submit(feed(), tenant="evil")         # admitted again: closed
+    pool._serve_once(0, fake)
+    trans = [(e["from"], e["to"])
+             for e in obs_journal.recent(event="serve_breaker")
+             if e["tenant"] == "evil"]
+    assert trans == [("closed", "open"), ("open", "half_open"),
+                     ("half_open", "closed")]
+    sid = trans and obs_journal.recent(event="serve_breaker")[0]["sig"]
+    assert REGISTRY.gauge("serving_breaker_state", tenant="evil",
+                          sig=sid).value == 0.0
+
+
+def test_breaker_mixed_batch_collateral_recovers_after_one_backoff():
+    """Blame is batch-granular: a healthy tenant co-batched (same sig)
+    with a poisoned one takes collateral failures and can trip its own
+    breaker -- but once the poisoned key fast-fails at admission, the
+    healthy key's half-open probe runs a clean batch and closes, while
+    the poisoned key's probe keeps failing and re-opens."""
+    clock = FakeClock()
+    fake = FakePredictor()
+    faults.clear()
+    faults.install("exc@serve_dispatch:var=evil:times=0")
+    pool = hermetic_pool([fake], clock, max_wait_ms=5.0,
+                         breaker_threshold=2, breaker_backoff_s=1.0)
+    try:
+        for _ in range(2):                      # two failing mixed batches
+            re = pool.submit(feed(), tenant="evil")
+            rg = pool.submit(feed(), tenant="good")
+            batch = pool._serve_once(0, fake)
+            assert {r.tenant for r in batch.requests} == {"evil", "good"}
+            for r in (re, rg):
+                with pytest.raises(ServingError):
+                    r.result(timeout=0)
+        # collateral: BOTH keys are open now
+        for t in ("evil", "good"):
+            with pytest.raises(BreakerOpen):
+                pool.submit(feed(), tenant=t)
+        # one backoff later: good's probe runs a CLEAN batch (evil cannot
+        # enter it -- its own breaker fast-fails its probe after the
+        # failing probe batch) and closes; evil stays open
+        clock.advance(1.1)
+        rg = pool.submit(feed(), tenant="good")
+        pool._serve_once(0, fake)
+        assert rg.result(timeout=0)[0].shape == (1, 4)
+        assert pool._breaker.state(("good", rg.sig)) == "closed"
+        re = pool.submit(feed(), tenant="evil")    # evil's half-open probe
+        pool._serve_once(0, fake)
+        with pytest.raises(ServingError):
+            re.result(timeout=0)
+        assert pool._breaker.state(("evil", re.sig)) == "open"
+        assert pool._pending == 0
+    finally:
+        faults.clear()
+
+
+# ------------------------------------------------------- head bypass (solo) --
+
+def test_head_bypass_cap_dispatches_solo():
+    """An oversize head bypassed by a stream of small compatible batches
+    is capped: after max_head_bypass bypasses it jumps the fair order and
+    serves solo (FakeClock, no sleeps)."""
+    clock = FakeClock()
+    q = TenantQueue(max_queue=64, clock=clock, max_head_bypass=3)
+    batcher = DynamicBatcher(max_batch=8, max_wait_ms=0.0, clock=clock)
+    big = Request(feed(rows=7), tenant="zbig")
+    assert q.try_push(big) is None
+    # one batch of smalls makes three fill attempts, each finding the big
+    # head oversize for the remaining space: three bypasses -> solo
+    for _ in range(3):
+        assert q.try_push(Request(feed(rows=2), tenant="asmall")) is None
+    b = batcher.form(q, timeout=0.01)
+    assert all(r.tenant == "asmall" for r in b.requests)
+    assert big.solo and big.bypassed == 3
+    # the next formation cannot bypass it again: it jumps the fair order
+    # and dispatches alone, even with compatible smalls queued
+    q.try_push(Request(feed(rows=2), tenant="asmall"))
+    b = batcher.form(q, timeout=0.01)
+    assert [r.tenant for r in b.requests] == ["zbig"]     # alone, at last
+    assert b.rows == 7 and b.padded_rows == 8
+
+
+# ----------------------------------------------------------------- hot swap --
+
+def test_hot_swap_hermetic_between_batches():
+    """Staged swap applies between batches; version finalizes when every
+    predictor rotated; journal + gauge carry it."""
+    clock = FakeClock()
+    fake = FakePredictor(mult=2.0)
+    pool = hermetic_pool([fake], clock)
+    obs_journal.clear()
+    r1 = pool.submit(feed(fill=1.0))
+    pool._serve_once(0, fake)
+    assert np.allclose(r1.result(timeout=0)[0], 2.0)      # old weights
+    assert pool.model_version == 1
+    new_version = pool.swap(state={"mult": np.float32(3.0)})
+    assert new_version == 2
+    assert pool.model_version == 1            # not yet rotated (hermetic)
+    r2 = pool.submit(feed(fill=1.0))
+    pool._serve_once(0, fake)                 # rotation happens here
+    assert np.allclose(r2.result(timeout=0)[0], 3.0)      # new weights
+    assert pool.model_version == 2 and fake.model_version == 2
+    swaps = obs_journal.recent(event="serve_swap")
+    assert swaps and swaps[-1]["outcome"] == "ok" \
+        and swaps[-1]["model_version"] == 2
+    batches = obs_journal.recent(event="serve_batch")
+    assert [e["model_version"] for e in batches] == [1, 2]
+
+
+def test_hot_swap_rejects_bad_state_typed():
+    clock = FakeClock()
+    fake = FakePredictor()
+    pool = hermetic_pool([fake], clock)
+    with pytest.raises(ServingError, match="swap rejected"):
+        pool.swap(state={"bogus": np.float32(1.0)})
+    assert pool.model_version == 1 and fake.mult == 2.0
+    with pytest.raises(ValueError):
+        pool.swap()                            # neither model_dir nor state
+
+
+def test_hot_swap_in_flight_batch_finishes_on_old_weights():
+    """A batch already executing when swap() is called completes on the
+    old weights; the next batch serves the new (threaded, gated)."""
+    class GatedSwappable(GatedFake):
+        def __init__(self):
+            super().__init__()
+            self.mult = 2.0
+            self.model_version = 1
+
+        def run(self, feed, dtype=None):
+            self.started.set()
+            assert self.gate.wait(30)
+            return [feed["x"] * self.mult]
+
+        def swap_state(self, state, validate_only=False,
+                       model_version=None):
+            if validate_only:
+                return
+            self.mult = float(np.asarray(state["mult"]))
+            if model_version is not None:
+                self.model_version = int(model_version)
+
+    fake = GatedSwappable()
+    pool = PredictorPool(predictors=[fake], max_batch=1, max_wait_ms=0.0)
+    try:
+        r1 = pool.submit(feed(fill=1.0))
+        assert fake.started.wait(10)           # r1 executing on OLD weights
+        done = []
+        swapper = threading.Thread(
+            target=lambda: done.append(
+                pool.swap(state={"mult": np.float32(5.0)})))
+        swapper.start()
+        time.sleep(0.1)                        # swap staged mid-batch
+        assert not done                        # blocked: r1 still in flight
+        fake.gate.set()
+        swapper.join(30)
+        assert done == [2]
+        assert np.allclose(r1.result(timeout=30)[0], 2.0)   # old weights
+        out, = pool.run(feed(fill=1.0), timeout=30)
+        assert np.allclose(out, 5.0)                        # new weights
+        assert pool.model_version == 2
+    finally:
+        fake.gate.set()
+        pool.close()
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    """Two real tiny-MLP inference models (different seeds)."""
+    from paddle_tpu.serving.__main__ import _build_mlp
+    da = str(tmp_path_factory.mktemp("swap_a"))
+    db = str(tmp_path_factory.mktemp("swap_b"))
+    _build_mlp(da, seed=11)
+    _build_mlp(db, seed=29)
+    return da, db
+
+
+def test_hot_swap_real_model_byte_equality(model_dirs):
+    """swap(model_dir): checksum-verified staging, byte-equal to solo
+    serving of the new model, old weights byte-equal before."""
+    from paddle_tpu.inference import Predictor
+    da, db = model_dirs
+    x = {"x": np.random.RandomState(7).randn(2, 8).astype("float32")}
+    ref_a = Predictor(da).run(x)[0]
+    ref_b = Predictor(db).run(x)[0]
+    assert ref_a.tobytes() != ref_b.tobytes()
+    pool = PredictorPool(da, size=2, max_batch=8, max_wait_ms=0.0)
+    try:
+        got = pool.run(x, timeout=120)[0]
+        assert got.tobytes() == ref_a.tobytes()
+        assert pool.swap(db) == 2
+        got = pool.run(x, timeout=120)[0]
+        assert got.tobytes() == ref_b.tobytes()
+        assert pool.model_version == 2
+    finally:
+        pool.close()
+
+
+def test_hot_swap_rejects_corrupt_checkpoint(model_dirs, tmp_path):
+    """A bit-flipped staged model fails the PR-8 crc verification: typed
+    rejection, the pool keeps serving the old weights untouched."""
+    import shutil
+    from paddle_tpu.inference import Predictor
+    da, db = model_dirs
+    bad = str(tmp_path / "bad_push")
+    shutil.copytree(db, bad)
+    chunk = sorted(f for f in os.listdir(bad) if f.endswith(".npy"))[0]
+    p = os.path.join(bad, chunk)
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0x01
+    open(p, "wb").write(bytes(blob))
+    x = {"x": np.random.RandomState(8).randn(1, 8).astype("float32")}
+    ref_a = Predictor(da).run(x)[0]
+    pool = PredictorPool(da, size=1, max_batch=4, max_wait_ms=0.0)
+    obs_journal.clear()
+    try:
+        with pytest.raises(ServingError, match="checksum"):
+            pool.swap(bad)
+        assert pool.model_version == 1
+        assert pool.run(x, timeout=120)[0].tobytes() == ref_a.tobytes()
+        rej = [e for e in obs_journal.recent(event="serve_swap")
+               if e.get("outcome") == "rejected"]
+        assert rej
+    finally:
+        pool.close()
+
+
+# ------------------------------------------------------------ drain timeout --
+
+def test_close_drain_timeout_fails_remaining_typed():
+    """A wedged worker cannot wedge close(): after drain_timeout the
+    remaining requests (queued AND held in-flight) fail typed and the
+    close completes; journaled serve_drain_timeout."""
+    fake = GatedFake()
+    pool = PredictorPool(predictors=[fake], max_batch=1, max_wait_ms=0.0)
+    obs_journal.clear()
+    held = pool.submit(feed())
+    assert fake.started.wait(10)               # worker wedged mid-batch
+    queued = [pool.submit(feed()) for _ in range(2)]
+    t0 = time.monotonic()
+    pool.close(drain=True, drain_timeout=0.3)  # completes, no TimeoutError
+    assert time.monotonic() - t0 < 5.0
+    for r in [held] + queued:
+        with pytest.raises(RequestShed) as ei:
+            r.result(timeout=0)
+        assert ei.value.reason == "closed"
+    evs = obs_journal.recent(event="serve_drain_timeout")
+    assert evs and evs[-1]["failed_in_flight"] == 1 \
+        and evs[-1]["failed_queued"] == 2
+    assert pool._pending == 0
+    fake.gate.set()                            # unwedge the abandoned thread
+
+
+# ------------------------------------------------------------- chaos CLI pin --
+
+def test_serving_chaos_cli():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-m", "paddle_tpu.serving",
+                        "--chaos", "--secs", "1.0", "--qps", "200"],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "serving chaos: OK" in r.stdout
+    assert '"phase": "poisoned_tenant"' in r.stdout
+    assert '"phase": "hot_swap"' in r.stdout
+    assert '"phase": "wedged_drain"' in r.stdout
+
+
+# ----------------------------------------------------- zero-overhead guards --
+
+def test_disarmed_serving_hot_path_zero_overhead():
+    """Faults disarmed => the serving hot path never calls a fault hook
+    (the guard is one module-attribute truthiness read) and opens no
+    files. Subprocess: sibling tests legitimately arm faults here."""
+    script = r"""
+import builtins, sys, threading
+import numpy as np
+import paddle_tpu  # noqa
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import PredictorPool
+
+assert not faults.armed()
+
+def boom(*a, **kw):
+    raise AssertionError("fault hook called with faults disarmed")
+faults.fire = boom
+faults.corrupt_serving = boom
+
+class Fake:
+    def run(self, feed, dtype=None):
+        return [feed["x"] * 2.0]
+
+pool = PredictorPool(predictors=[Fake()], max_batch=8, max_wait_ms=0.0)
+x = {"x": np.ones((1, 4), "float32")}
+pool.run(x, timeout=30)                       # warm every lazy path
+
+opens = []
+real_open = builtins.open
+builtins.open = lambda *a, **kw: (opens.append(a), real_open(*a, **kw))[1]
+try:
+    for _ in range(20):
+        out, = pool.run(x, timeout=30)
+        assert out.shape == (1, 4)
+finally:
+    builtins.open = real_open
+assert not opens, f"serving hot path opened files: {opens[:3]}"
+pool.close()
+print("GUARD-OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_FAULTS", None)
+    env.pop("PADDLE_TPU_OBS", None)
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "GUARD-OK" in r.stdout
+
+
+# ----------------------------------------------------------- misc invariants --
+
+def test_request_first_write_wins():
+    r = Request(feed())
+    assert r.set_result([np.ones(1)]) is True
+    assert r.set_exception(RuntimeError("late")) is False
+    assert r.result(timeout=0)[0].shape == (1,)
+    r2 = Request(feed())
+    assert r2.set_exception(ServingError("first")) is True
+    assert r2.set_result([np.ones(1)]) is False
+    with pytest.raises(ServingError, match="first"):
+        r2.result(timeout=0)
+
+
+def test_scatter_reports_resolved_count():
+    a, b = Request(feed(rows=1)), Request(feed(rows=1))
+    b.set_exception(RequestTimeout("t", 5.0, 4.0))   # expired mid-flight
+    batch = Batch([a, b])
+    n = batch.scatter([np.zeros((2, 4), "float32")])
+    assert n == 1                                    # only `a` resolved here
+    assert a.result(timeout=0)[0].shape == (1, 4)
+    with pytest.raises(RequestTimeout):
+        b.result(timeout=0)
+
+
+def test_nan_serve_fetch_fault_fails_typed_with_check_outputs():
+    """nan@serve_fetch + check_outputs: the poisoned batch fails typed
+    (never silent NaN bytes to the caller)."""
+    faults.clear()
+    faults.install("nan@serve_fetch:times=1")
+    fake = FakePredictor()
+    pool = PredictorPool(predictors=[fake], max_batch=4, max_wait_ms=0.0,
+                         check_outputs=True)
+    try:
+        with pytest.raises(ServingError, match="nonfinite"):
+            pool.run(feed(), timeout=30)
+        out, = pool.run(feed(fill=2.0), timeout=30)  # fault consumed
+        assert np.allclose(out, 4.0)
+    finally:
+        faults.clear()
+        pool.close()

@@ -290,6 +290,63 @@ def render_serving(events: Optional[List[dict]],
             by[k] = by.get(k, 0) + 1
         for k, n in sorted(by.items()):
             lines.append(f"  shed {k}: x{n}")
+    # reliability rows (ISSUE 13): deadlines, breaker, swap, crash, drain
+    n_timeout = _counter_total(snapshot, "serving_timeout_total")
+    t_events = [e for e in events if e.get("event") == "serve_timeout"]
+    if n_timeout or t_events:
+        by_t = {}
+        for e in t_events:
+            by_t[e.get("tenant", "?")] = by_t.get(e.get("tenant", "?"), 0) + 1
+        detail = " ".join(f"{t}: x{n}" for t, n in sorted(by_t.items()))
+        lines.append(f"deadline timeouts: {n_timeout if n_timeout else len(t_events):g}"
+                     + (f" ({detail})" if detail else ""))
+    trans = [e for e in events if e.get("event") == "serve_breaker"]
+    state_names = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+    open_now = []
+    for s in fams.get("serving_breaker_state", {}).get("samples", []):
+        if s.get("value"):
+            lbl = s.get("labels", {})
+            open_now.append(f"{lbl.get('tenant', '?')}/{lbl.get('sig', '?')}"
+                            f"={state_names.get(s.get('value'), '?')}")
+    if trans or open_now:
+        opens = sum(1 for e in trans if e.get("to") == "open")
+        closes = sum(1 for e in trans if e.get("to") == "closed")
+        lines.append(f"breaker: {len(trans)} transition(s) "
+                     f"({opens} open, {closes} re-closed)"
+                     + (f"; now not-closed: {', '.join(sorted(open_now))}"
+                        if open_now else ""))
+        for e in trans[-5:]:
+            lines.append(f"  BREAKER {e.get('tenant')}/{e.get('sig')} "
+                         f"{e.get('from')} -> {e.get('to')} "
+                         f"(failures {e.get('failures')})")
+    swaps = [e for e in events if e.get("event") == "serve_swap"]
+    if swaps:
+        ok = [e for e in swaps if e.get("outcome") == "ok"]
+        rej = [e for e in swaps if e.get("outcome") == "rejected"]
+        lines.append(f"hot swaps: {len(ok)} ok, {len(rej)} rejected")
+        for e in ok[-3:]:
+            ms = e.get("swap_ms")
+            lines.append(f"  SWAP -> model_version {e.get('model_version')}"
+                         + (f" in {ms}ms" if ms is not None else ""))
+        for e in rej[-3:]:
+            lines.append(f"  SWAP REJECTED: {str(e.get('error', ''))[:90]}")
+    for s in fams.get("serving_model_version", {}).get("samples", []):
+        if s.get("value", 0) > 1:
+            lines.append(f"model version now: {s.get('value'):g}")
+    n_crash = _counter_total(snapshot, "serving_worker_crash_total")
+    crash_events = [e for e in events if e.get("event") ==
+                    "serve_worker_crash"]
+    if n_crash or crash_events:
+        lines.append(f"worker crashes (respawned): "
+                     f"{n_crash if n_crash else len(crash_events):g}")
+        for e in crash_events[-3:]:
+            lines.append(f"  CRASH worker {e.get('worker')}: "
+                         f"{str(e.get('error', ''))[:90]}")
+    for e in [e for e in events
+              if e.get("event") == "serve_drain_timeout"][-3:]:
+        lines.append(f"DRAIN TIMEOUT after {e.get('waited_s')}s: "
+                     f"{e.get('failed_queued')} queued + "
+                     f"{e.get('failed_in_flight')} in-flight failed typed")
     for s in fams.get("serving_queue_depth", {}).get("samples", []):
         lines.append(f"queue depth now: {s.get('value', 0.0):g}")
     for s in fams.get("serving_in_flight", {}).get("samples", []):
@@ -660,6 +717,11 @@ def selftest() -> int:
     reg.counter("serving_requests_total", tenant="a", outcome="shed").inc()
     for v in (0.004, 0.006, 0.009):
         reg.histogram("serving_request_seconds", tenant="a").observe(v)
+    # serving reliability sources (ISSUE 13)
+    reg.counter("serving_timeout_total", tenant="a").inc(2)
+    reg.gauge("serving_breaker_state", tenant="evil", sig="00c0ffee").set(2)
+    reg.gauge("serving_model_version").set(2)
+    reg.counter("serving_worker_crash_total").inc()
 
     events = [
         {"event": "run", "program": 1, "version": 0, "cache": "miss",
@@ -726,6 +788,20 @@ def selftest() -> int:
          "tenants": {"a": 4, "b": 2}, "ts": 9.85},
         {"event": "serve_shed", "tenant": "a", "reason": "tenant_quota",
          "ts": 9.9},
+        # serving reliability (deadlines / breaker / swap / crash / drain)
+        {"event": "serve_timeout", "tenant": "a", "waited_ms": 52.0,
+         "deadline_ms": 50.0, "ts": 9.91},
+        {"event": "serve_timeout", "tenant": "a", "waited_ms": 61.0,
+         "deadline_ms": 50.0, "ts": 9.92},
+        {"event": "serve_breaker", "tenant": "evil", "sig": "00c0ffee",
+         "from": "closed", "to": "open", "failures": 3, "backoff_s": 0.5,
+         "ts": 9.93},
+        {"event": "serve_swap", "outcome": "ok", "model_version": 2,
+         "swap_ms": 41.2, "ts": 9.94},
+        {"event": "serve_worker_crash", "worker": 1,
+         "error": "TransientFault: UNAVAILABLE: injected", "ts": 9.95},
+        {"event": "serve_drain_timeout", "failed_queued": 2,
+         "failed_in_flight": 1, "waited_s": 0.4, "ts": 9.96},
     ]
 
     # a synthetic flight-recorder trace through the real exporter
@@ -797,6 +873,18 @@ def selftest() -> int:
                      "shed rate: 10.0% (1 of 10 offered)",
                      "shed a/tenant_quota: x1", "queue depth now: 2",
                      "tenant a: n=3", "p99<=",
+                     # serving reliability rows (ISSUE 13)
+                     "deadline timeouts: 2 (a: x2)",
+                     "breaker: 1 transition(s) (1 open, 0 re-closed)",
+                     "now not-closed: evil/00c0ffee=open",
+                     "BREAKER evil/00c0ffee closed -> open (failures 3)",
+                     "hot swaps: 1 ok, 0 rejected",
+                     "SWAP -> model_version 2 in 41.2ms",
+                     "model version now: 2",
+                     "worker crashes (respawned): 1",
+                     "CRASH worker 1: TransientFault",
+                     "DRAIN TIMEOUT after 0.4s: 2 queued + 1 in-flight "
+                     "failed typed",
                      # goodput section (wall-clock ledger)
                      "== Goodput ==", "-> goodput",
                      "dispatch + fetch_sync", "lost compile",
