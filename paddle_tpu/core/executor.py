@@ -1189,7 +1189,7 @@ class Executor:
             self._closing = False
 
     @staticmethod
-    def _prefetch_batches(batches, depth, fuse: int = 1):
+    def _prefetch_batches(batches, depth, fuse: int = 1, abort=None):
         """Host-side double buffering (VERDICT r4 #5): a worker thread runs
         the dataset's parse/slice/stack generator ahead of the device loop
         through a bounded queue, so batch k+1's host work overlaps batch k's
@@ -1292,6 +1292,17 @@ class Executor:
                 yield item
         finally:
             stop.set()
+            # a streaming dataset's batch iterator exposes abort(): wind
+            # its source-reader threads down when the epoch is abandoned
+            # mid-flight (the worker above may be parked inside the
+            # iterator waiting on stream data, where generator close()
+            # cannot reach from this thread).  Callers that WRAP the
+            # iterator (islice for skip_batches, chain for the fuse
+            # peek) pass the unwrapped hook via ``abort``.
+            cb = abort if abort is not None \
+                else getattr(batches, "abort", None)
+            if cb is not None:
+                cb()
 
     @staticmethod
     def _prefetch_depth(thread, dataset):
@@ -1340,7 +1351,7 @@ class Executor:
         return 1, chained, params
 
     def _fused_search_epoch(self, program, batches, depth, fetch_list,
-                            scope, params, step_cb):
+                            scope, params, step_cb, abort=None):
         """In-loop ``fuse_steps.k`` search: measure candidate K values on
         the LIVE workload (search megasteps ARE training steps -- every
         update commits normally), persist the winner through the PR-4
@@ -1356,7 +1367,7 @@ class Executor:
         from ..tuning.measure import _force
         choice = _tuning.get_choice("fuse_steps.k")
         cands = sorted(int(c) for c in choice.candidates(params))
-        it = iter(self._prefetch_batches(batches, depth))
+        it = iter(self._prefetch_batches(batches, depth, abort=abort))
         timings: Dict[str, dict] = {}
         t_search = _time.perf_counter()
         prog_obj = (program.program if program is not None and
@@ -1490,6 +1501,9 @@ class Executor:
                 k = 1
         depth = self._prefetch_depth(thread, dataset)
         batches = dataset._iter_batches()
+        # grab the stream-abort hook BEFORE any wrapping (islice/chain
+        # below would hide it from the prefetch loop's finally)
+        abort_cb = getattr(batches, "abort", None)
         if skip_batches:
             import itertools
             batches = itertools.islice(batches, int(skip_batches), None)
@@ -1522,9 +1536,11 @@ class Executor:
 
         if search_params is not None:
             self._fused_search_epoch(program, batches, depth, fetch_list,
-                                     scope, search_params, step_cb)
+                                     scope, search_params, step_cb,
+                                     abort=abort_cb)
         elif k > 1:
-            for item in self._prefetch_batches(batches, depth, fuse=k):
+            for item in self._prefetch_batches(batches, depth, fuse=k,
+                                               abort=abort_cb):
                 if item[0] == "mega":
                     vals = self.run_fused(program, stacked_feed=item[1],
                                           fetch_list=fetch_list,
@@ -1536,7 +1552,8 @@ class Executor:
                                     return_numpy=False)
                     step_cb(vals, 1, fused=False)
         else:
-            for feed in self._prefetch_batches(batches, depth):
+            for feed in self._prefetch_batches(batches, depth,
+                                               abort=abort_cb):
                 vals = self.run(program, feed=feed, fetch_list=fetch_list,
                                 scope=scope, return_numpy=False)
                 step_cb(vals, 1, fused=False)

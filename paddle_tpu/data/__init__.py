@@ -1,0 +1,44 @@
+"""Fault-tolerant data plane: streaming ingestion with source retry,
+poison-record quarantine, and exact mid-stream resume.
+
+Deliberately NOT imported by ``paddle_tpu/__init__.py``: a finite-dataset
+run that never streams pays nothing -- no reader threads, no buffers, no
+dead-letter files (guard-tested, the serving-tier discipline).
+
+    from paddle_tpu.data import StreamingDataset, FileTailSource
+    ds = StreamingDataset()
+    ds.add_source(FileTailSource("clicks.txt", follow=True))
+    ds.set_use_var([x, label]); ds.set_batch_size(64)
+    ds.set_epoch_bound(steps=1000)
+    exe.train_from_dataset(main, ds, fetch_list=[loss])
+
+NAMING NOTE: ``paddle_tpu.data`` was already the ``fluid.data(...)``
+input-layer *function* (``layers/io.py``).  Importing this package rebinds
+the parent attribute ``data`` from that function to this module, so the
+module itself is made callable and forwards -- both
+``fluid.data("x", [8], "float32")`` and
+``paddle_tpu.data.StreamingDataset`` work, in either import order
+(pinned by the test suite).
+"""
+import sys
+import types
+
+from ..layers.io import data as _data_layer_fn
+from .streaming import (FileTailSource, GeneratorSource,  # noqa: F401
+                        PoisonFeed, SocketSource, SourceLost, StreamError,
+                        StreamSource, StreamingDataset)
+
+__all__ = [
+    "FileTailSource", "GeneratorSource", "PoisonFeed", "SocketSource",
+    "SourceLost", "StreamError", "StreamSource", "StreamingDataset",
+]
+
+
+class _CallableDataModule(types.ModuleType):
+    """Module subclass forwarding calls to the ``fluid.data`` layer fn."""
+
+    def __call__(self, *args, **kwargs):
+        return _data_layer_fn(*args, **kwargs)
+
+
+sys.modules[__name__].__class__ = _CallableDataModule

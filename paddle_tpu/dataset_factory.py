@@ -24,6 +24,75 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+BAD_SAMPLE_POLICIES = ("raise", "quarantine")
+MISSING_FILE_POLICIES = ("raise", "skip")
+
+
+class PoisonFeed(RuntimeError):
+    """The quarantined-sample rate crossed the configured ceiling: the
+    feed itself is corrupt (schema drift, upstream breakage), and silently
+    training on whatever still parses would be worse than stopping.
+    Raised typed by the shared ``on_bad_sample='quarantine'`` path
+    (finite datasets here and ``paddle_tpu.data.StreamingDataset``)."""
+
+    def __init__(self, msg: str, quarantined: int = 0, total: int = 0):
+        super().__init__(msg)
+        self.quarantined = quarantined
+        self.total = total
+
+
+class DeadLetterWriter:
+    """Append-only JSONL sink for quarantined records: one line per
+    poison sample carrying the source attribution (``where`` =
+    ``file:line`` or ``source:position``), the failure reason, and the
+    offending text (truncated).  Opened lazily on the first quarantine,
+    flushed per write (a crashed run must not lose the evidence).
+    Deduplicated by position -- a multi-epoch run re-parsing the same
+    file, or a resume replaying the torn window past the last committed
+    watermark, records each poison line ONCE (existing entries are
+    re-read on open so dedup survives process restarts)."""
+
+    MAX_TEXT = 512
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._seen = None   # where-keys already recorded (lazy)
+
+    def write(self, where: str, reason: str, error: str, text: str) -> bool:
+        """Record one poison line; returns False (and writes nothing) if
+        this position was already dead-lettered."""
+        import json
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._seen = set()
+            if os.path.exists(self.path):
+                try:
+                    with open(self.path) as f:
+                        for ln in f:
+                            if ln.strip():
+                                self._seen.add(
+                                    json.loads(ln).get("where"))
+                except (OSError, ValueError):
+                    pass   # unreadable prior entries: record anew
+            self._f = open(self.path, "a")
+        if where in self._seen:
+            return False
+        self._seen.add(where)
+        self._f.write(json.dumps(
+            {"where": where, "reason": reason, "error": str(error)[:200],
+             "line": str(text)[:self.MAX_TEXT]}, sort_keys=True) + "\n")
+        self._f.flush()
+        return True
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+            self._seen = None
+
 
 class DatasetBase:
     def __init__(self):
@@ -32,11 +101,25 @@ class DatasetBase:
         self.filelist: List[str] = []
         self.thread_num = 1
         self.drop_last = False
+        self.on_missing_file = "raise"   # or "skip" (journals the skip)
         self._parse_fn: Optional[Callable] = None
         self._samples = None     # row list of tuples OR columnar matrices
         self._perm = None        # shuffle permutation (a view, not a copy)
         self._stripe = None      # (rank, world) view set by global_shuffle
         self._epoch_seed = 0
+        # poison-record policy (shared with paddle_tpu.data streaming):
+        # "raise" (default, the historical behavior) or "quarantine"
+        self._bad_policy = "raise"
+        self._dead_letter: Optional[DeadLetterWriter] = None
+        self._max_poison_rate: Optional[float] = None
+        self._poison_floor = 20          # min samples before the ceiling arms
+        # ceiling window (reset per load/epoch: the ceiling asks "is the
+        # feed corrupt NOW", so a past burst must not poison the ratio of
+        # a later pass) vs _quarantined, the CUMULATIVE dead-letter count
+        # that rides the streaming watermark
+        self._parse_total = 0            # counted only under quarantine
+        self._rate_quarantined = 0
+        self._quarantined = 0
 
     # -- reference config surface ------------------------------------------------------
     def set_batch_size(self, batch_size):
@@ -64,34 +147,154 @@ class DatasetBase:
         """TPU extension: fn(line:str) -> tuple of arrays/scalars per use_var."""
         self._parse_fn = fn
 
+    def set_missing_file_policy(self, policy: str):
+        """``"raise"`` (default): a missing file in the filelist aborts the
+        load (the historical behavior).  ``"skip"``: the file is skipped,
+        journaled as a ``source_skipped`` event and counted in
+        ``sources_skipped_total`` -- a production feed where one shard
+        lagging the publisher must not abort the whole multi-file load."""
+        if policy not in MISSING_FILE_POLICIES:
+            raise ValueError(f"on_missing_file must be one of "
+                             f"{MISSING_FILE_POLICIES}, got {policy!r}")
+        self.on_missing_file = policy
+
+    def set_bad_sample_policy(self, policy: str = "quarantine",
+                              dead_letter_path: Optional[str] = None,
+                              max_poison_rate: Optional[float] = None,
+                              poison_floor: int = 20):
+        """``"raise"`` (default): a malformed line aborts with a ValueError
+        carrying the source position.  ``"quarantine"``: the line is
+        appended to the dead-letter file (``dead_letter_path``, default
+        ``paddle_tpu_dead_letters.jsonl``) with source attribution,
+        counted in ``samples_quarantined_total{reason}``, and skipped --
+        unless the quarantine rate crosses ``max_poison_rate`` (checked
+        once at least ``poison_floor`` samples were parsed), which raises
+        a typed :class:`PoisonFeed` instead of silently training on a
+        corrupt feed."""
+        if policy not in BAD_SAMPLE_POLICIES:
+            raise ValueError(f"on_bad_sample must be one of "
+                             f"{BAD_SAMPLE_POLICIES}, got {policy!r}")
+        self._bad_policy = policy
+        if policy == "quarantine":
+            if self._dead_letter is not None:   # re-arm: no fd leak
+                self._dead_letter.close()
+            self._dead_letter = DeadLetterWriter(
+                dead_letter_path or "paddle_tpu_dead_letters.jsonl")
+            self._max_poison_rate = (None if max_poison_rate is None
+                                     else float(max_poison_rate))
+            self._poison_floor = int(poison_floor)
+        else:
+            if self._dead_letter is not None:
+                self._dead_letter.close()
+            self._dead_letter = None
+            self._max_poison_rate = None
+
     # -- parsing -----------------------------------------------------------------------
-    def _parse_line(self, line):
+    def _parse_line(self, line, where: Optional[str] = None):
         if self._parse_fn is not None:
             return tuple(self._parse_fn(line))
         slots = line.strip().split(";")
         if len(slots) != len(self.use_vars):
+            at = f" at {where}" if where else ""
             raise ValueError(
-                f"line has {len(slots)} slots but set_use_var lists "
+                f"line{at} has {len(slots)} slots but set_use_var lists "
                 f"{len(self.use_vars)} vars (separate slots with ';' or use "
                 f"set_parse_fn)")
         out = []
         for s, v in zip(slots, self.use_vars):
             dt = v.dtype if v.dtype != "bfloat16" else "float32"
             vals = s.split()
-            out.append(np.asarray(vals, dtype=np.dtype(dt))
-                       if vals else np.zeros((0,), dt))
+            try:
+                out.append(np.asarray(vals, dtype=np.dtype(dt))
+                           if vals else np.zeros((0,), dt))
+            except ValueError as e:
+                at = f" at {where}" if where else ""
+                raise ValueError(
+                    f"slot for var {v.name!r}{at} does not parse as "
+                    f"{dt}: {e}") from e
         return tuple(out)
+
+    def _parse_guarded(self, line, where: Optional[str] = None):
+        """One line through :meth:`_parse_line` under the bad-sample
+        policy: returns the parsed tuple, or None when the line was
+        quarantined (``on_bad_sample='quarantine'``).  The default
+        ``raise`` path adds no try/except on top of the plain parse."""
+        if self._bad_policy == "raise":
+            return self._parse_line(line, where=where)
+        self._parse_total += 1
+        try:
+            return self._parse_line(line, where=where)
+        except PoisonFeed:
+            raise
+        except Exception as e:  # noqa: BLE001 -- every parse failure
+            self._quarantine(line, where, e)
+            return None
+
+    def _quarantine(self, line, where, err):
+        """Dead-letter one malformed line (counter + journal + JSONL
+        record with source attribution), then enforce the poison-rate
+        ceiling."""
+        reason = ("slot_count" if "slots but set_use_var" in str(err)
+                  else "parse_error")
+        self._quarantined += 1
+        self._rate_quarantined += 1
+        # counter/journal only on a NEW position: a re-parse (another
+        # epoch, a resumed torn window) must not inflate the series --
+        # the ceiling's _quarantined/_parse_total pair still counts per
+        # parse so the rate stays consistent within an epoch
+        if self._dead_letter.write(where or "?", reason, err, line):
+            from .observability import journal as _journal
+            from .observability.metrics import REGISTRY as _OBS
+            _OBS.counter("samples_quarantined_total",
+                         "malformed samples dead-lettered by the "
+                         "quarantine policy, by reason",
+                         reason=reason).inc()
+            _journal.emit({"event": "sample_quarantined", "where": where,
+                           "reason": reason, "error": str(err)[:120],
+                           "dead_letter": self._dead_letter.path})
+        if (self._max_poison_rate is not None and
+                self._parse_total >= self._poison_floor and
+                self._rate_quarantined / self._parse_total >
+                self._max_poison_rate):
+            raise PoisonFeed(
+                f"poison-record rate {self._rate_quarantined}/"
+                f"{self._parse_total} = "
+                f"{self._rate_quarantined / self._parse_total:.1%} exceeds "
+                f"the {self._max_poison_rate:.1%} ceiling (last offender "
+                f"{where}); the feed looks corrupt -- refusing to keep "
+                f"training on it (dead letters: {self._dead_letter.path})",
+                quarantined=self._rate_quarantined,
+                total=self._parse_total)
+
+    def _reset_poison_window(self):
+        """New load/epoch: the poison-rate ceiling judges THIS pass."""
+        self._parse_total = 0
+        self._rate_quarantined = 0
+
+    def _missing_file(self, path) -> bool:
+        """Missing-file policy: True = skip this path (journaled), else
+        raise the historical FileNotFoundError."""
+        if self.on_missing_file != "skip":
+            raise FileNotFoundError(f"dataset file {path!r} not found")
+        from .observability import journal as _journal
+        from .observability.metrics import REGISTRY as _OBS
+        _OBS.counter("sources_skipped_total",
+                     "dataset files skipped by on_missing_file=skip").inc()
+        _journal.emit({"event": "source_skipped", "file": str(path)})
+        return True
 
     def _read_files(self):
         """Returns either columnar matrices (native C++ parse -- one
         contiguous [N, width] array per slot, no per-row object churn) or a
         row list of tuples (Python fallback). Both shapes are understood by
         _iter_batches and the shuffles (which permute an index array)."""
+        self._reset_poison_window()
         col_parts: Optional[List[List[np.ndarray]]] = None
         samples = []
         for path in self.filelist:
             if not os.path.exists(path):
-                raise FileNotFoundError(f"dataset file {path!r} not found")
+                if self._missing_file(path):
+                    continue
             native = self._read_native(path)
             if native is not None and not samples:
                 if col_parts is None:
@@ -107,9 +310,11 @@ class DatasetBase:
                 samples.extend(zip(*[list(c) for c in cols]))
                 col_parts = None
             with open(path) as f:
-                for line in f:
+                for ln, line in enumerate(f, 1):
                     if line.strip():
-                        samples.append(self._parse_line(line))
+                        s = self._parse_guarded(line, where=f"{path}:{ln}")
+                        if s is not None:
+                            samples.append(s)
         if col_parts is not None and not samples:
             return [np.concatenate(p) for p in col_parts]
         return samples
@@ -253,6 +458,7 @@ class QueueDataset(DatasetBase):
         if self._samples is not None:   # pre-loaded (tests): eager path
             yield from DatasetBase._iter_batches(self)
             return
+        self._reset_poison_window()
         names = [v.name for v in self.use_vars]
         bs = self.batch_size
         stripe = self._stripe
@@ -296,18 +502,22 @@ class QueueDataset(DatasetBase):
                 n_yielded += 1
                 yield b
 
-        for fi, path in enumerate(self.filelist):
+        for path in self.filelist:
             if not os.path.exists(path):
-                raise FileNotFoundError(f"dataset file {path!r} not found")
+                if self._missing_file(path):
+                    continue
             native = self._read_native(path)
             if native is not None:
                 cols, columnar = native, True
             else:
                 rows = []
                 with open(path) as f:
-                    for line in f:
+                    for ln, line in enumerate(f, 1):
                         if line.strip():
-                            rows.append(self._parse_line(line))
+                            s = self._parse_guarded(
+                                line, where=f"{path}:{ln}")
+                            if s is not None:
+                                rows.append(s)
                 cols, columnar = rows, False
             if columnar_mode is None:
                 columnar_mode = columnar
@@ -332,8 +542,15 @@ class QueueDataset(DatasetBase):
             else:
                 rows_kept += n
             row_base += n
-            final = fi == len(self.filelist) - 1
-            yield from counting(flush(cols, columnar_mode, final=final))
+            yield from counting(flush(cols, columnar_mode, final=False))
+        # ONE final flush of the carried remainder after the loop -- it
+        # owes its partial batch whether the last file streamed, was
+        # skipped by on_missing_file, or the filelist was empty
+        if pend is not None:
+            yield from counting(flush([c[:0] for c in pend], True,
+                                      final=True))
+        elif pend_rows:
+            yield from counting(flush([], False, final=True))
         if n_yielded == 0:
             import warnings
             warnings.warn(
@@ -349,4 +566,9 @@ class DatasetFactory:
             return InMemoryDataset()
         if datafeed_class == "QueueDataset":
             return QueueDataset()
+        if datafeed_class == "StreamingDataset":
+            # lazy: the streaming data plane (reader threads, buffers) is
+            # paid for only when asked for (zero-overhead guard)
+            from .data import StreamingDataset
+            return StreamingDataset()
         raise ValueError(f"unknown dataset class {datafeed_class!r}")

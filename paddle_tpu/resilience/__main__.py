@@ -129,6 +129,228 @@ def run_chaos(steps: int = 10, faults_spec: Optional[str] = None,
     return summary
 
 
+def _write_stream_file(path: str, n_good: int, dim: int, seed: int,
+                       poison_rate: float) -> int:
+    """Seeded synthetic click-stream file: ``n_good`` parseable records
+    (one slot of ``dim`` floats) with malformed lines interleaved at
+    ``poison_rate``.  Returns the poison-line count."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    n_poison = 0
+    with open(path, "w") as f:
+        good = 0
+        while good < n_good:
+            if poison_rate and rs.rand() < poison_rate:
+                n_poison += 1
+                f.write(f"POISON {n_poison};;\n")   # wrong slot count
+                continue
+            f.write(" ".join(f"{v:.6f}" for v in
+                             rs.rand(dim).astype("float32")) + "\n")
+            good += 1
+    return n_poison
+
+
+def run_stream_chaos(steps: int = 12, batch: int = 4, dim: int = 8,
+                     seed: int = 0, poison_rate: float = 0.05,
+                     read_fault_prob: float = 0.1,
+                     preempt_step: Optional[int] = None,
+                     work_dir: Optional[str] = None,
+                     save_interval: int = 3,
+                     hermetic: bool = True) -> dict:
+    """Streaming-ingestion chaos: flaky source + poison burst + mid-stream
+    preemption, end to end (ISSUE 14 acceptance).
+
+    A seeded stream file (``poison_rate`` malformed lines interleaved)
+    feeds a :class:`~paddle_tpu.data.StreamingDataset` under
+    ``exc@read(prob=read_fault_prob)`` faults and a ``preempt`` fault at
+    ``preempt_step`` (default: mid-run).  The guardian emergency-saves at
+    the preemption boundary with the stream watermark riding in
+    ``trainstate.json``; the run then restores, seeks the stream, and
+    finishes.  A clean uninterrupted run over the same stream prefix must
+    produce byte-identical losses; every poison line must land in the
+    dead-letter file with source attribution; quarantine/retry/freshness
+    series must be live in the metrics registry.  ``hermetic`` drives all
+    stream waiting through a FakeClock (no sleeps) -- the selftest mode."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.data import FileTailSource, StreamingDataset
+    from paddle_tpu.observability import journal as _journal
+    from paddle_tpu.observability.export import to_prometheus
+    from paddle_tpu.observability.metrics import REGISTRY as _OBS
+    from paddle_tpu.utils.checkpointer import Checkpointer
+    from paddle_tpu.utils.clock import FakeClock
+
+    from . import faults as _faults
+    from . import recovery as _recovery
+
+    t0 = time.time()
+    base = work_dir or tempfile.mkdtemp(prefix="paddle_tpu_stream_")
+    os.makedirs(base, exist_ok=True)
+    stream_path = os.path.join(base, "stream.txt")
+    n_poison = _write_stream_file(stream_path, steps * batch, dim, seed,
+                                  poison_rate)
+    if preempt_step is None:
+        preempt_step = steps // 2
+    main, startup, loss = _build_workload(dim, seed)
+    x_var = main.global_block().vars["x"]
+
+    def make_ds(dead_letter, use_var=None):
+        ds = StreamingDataset(clock=FakeClock() if hermetic else None,
+                              retry_seed=seed, max_retries=8)
+        ds.add_source(FileTailSource(stream_path, name="clickstream"))
+        ds.set_use_var([use_var if use_var is not None else x_var])
+        ds.set_batch_size(batch)
+        ds.set_bad_sample_policy("quarantine", dead_letter_path=dead_letter,
+                                 max_poison_rate=0.5)
+        ds.set_epoch_bound(steps=steps)
+        return ds
+
+    def hexlosses(d):
+        return [np.float32(d[i]).tobytes().hex() if i in d else None
+                for i in range(steps)]
+
+    summary = {"steps": steps, "batch": batch, "poison_lines": n_poison,
+               "preempt_step": preempt_step, "steps_completed": 0,
+               "preempted": None, "resumed": False,
+               "byte_identical": None, "dead_letters_attributed": None,
+               "metrics_live": None, "work_dir": base, "ok": False}
+
+    # -- phase A: faulted run with mid-stream preemption ---------------------
+    spec = f"preempt:step={preempt_step}"
+    if read_fault_prob:   # prob=0 means "no read faults", not an armed 0%
+        spec = (f"exc@read:prob={read_fault_prob}:seed={seed + 1}"
+                f":times=0;" + spec)
+    _faults.install(spec)
+    dead_a = os.path.join(base, "dead_interrupted.jsonl")
+    losses: dict = {}
+
+    def cb(n_consumed, vals, base_step=0):
+        if vals:
+            losses[base_step + n_consumed - 1] = float(
+                np.asarray(vals[0]).reshape(-1)[0])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ck = Checkpointer(exe, main, os.path.join(base, "ck"),
+                          save_interval_steps=save_interval)
+        g = _recovery.StepGuardian(exe, main, checkpointer=ck,
+                                   retry_backoff=0.01, retry_seed=seed)
+        preempted = None
+        try:
+            g.train_from_dataset(dataset=make_ds(dead_a),
+                                 fetch_list=[loss], step_cb=cb)
+            g.close()
+        except _recovery.Preempted as p:
+            preempted = p
+            summary["preempted"] = {"step": p.step,
+                                    "saved_step": p.saved_step}
+        if preempted is not None and preempted.saved_step is not None:
+            _recovery.clear_preemption()
+            exe2 = fluid.Executor()
+            ck2 = Checkpointer(exe2, main, os.path.join(base, "ck"))
+            start = ck2.restore() + 1
+            ts = ck2.train_state or {}
+            ds2 = make_ds(dead_a)
+            ds2.seek(ts.get("stream"))
+            ds2.set_epoch_bound(steps=steps - start)
+            g2 = _recovery.StepGuardian(exe2, main, checkpointer=ck2,
+                                        retry_backoff=0.01,
+                                        retry_seed=seed, start_step=start)
+            g2.train_from_dataset(
+                dataset=ds2, fetch_list=[loss],
+                step_cb=lambda n, v: cb(n, v, base_step=start))
+            g2.close()
+            summary["resumed"] = True
+            summary["resume_start_step"] = start
+    _faults.clear()
+    _recovery.clear_preemption()
+    summary["steps_completed"] = len(losses)
+
+    # -- phase B: clean uninterrupted reference over the same prefix ---------
+    # rebuilt from scratch (fresh Programs: the phase-A startup run
+    # consumed the original startup program's rng-run counter, so re-using
+    # it would initialize different weights)
+    main_b, startup_b, loss_b = _build_workload(dim, seed)
+    dead_b = os.path.join(base, "dead_reference.jsonl")
+    ref_losses: dict = {}
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor()
+        exe_b.run(startup_b)
+        g_b = _recovery.StepGuardian(exe_b, main_b, retry_backoff=0.01,
+                                     retry_seed=seed)
+        g_b.train_from_dataset(
+            dataset=make_ds(dead_b,
+                            use_var=main_b.global_block().vars["x"]),
+            fetch_list=[loss_b],
+            step_cb=lambda n, v: (ref_losses.__setitem__(
+                n - 1, float(np.asarray(v[0]).reshape(-1)[0]))
+                if v else None))
+        g_b.close()
+
+    summary["losses_hex"] = hexlosses(losses)
+    summary["reference_hex"] = hexlosses(ref_losses)
+    summary["byte_identical"] = (
+        len(losses) == steps == len(ref_losses) and
+        summary["losses_hex"] == summary["reference_hex"])
+
+    # -- verdicts ------------------------------------------------------------
+    def read_dead(p):
+        if not os.path.exists(p):
+            return []
+        return [json.loads(ln) for ln in open(p) if ln.strip()]
+
+    da, db = read_dead(dead_a), read_dead(dead_b)
+    # the torn window between the last committed batch and the preemption
+    # may re-quarantine a poison line on resume (documented), so the
+    # interrupted file is judged on UNIQUE positions
+    uniq_a = {r["where"] for r in da}
+    summary["dead_letters_attributed"] = (
+        len(uniq_a) == n_poison == len(db) and
+        all(r["where"].startswith("clickstream:") and r["reason"]
+            for r in da + db))
+    prom = to_prometheus(_OBS)
+    summary["metrics_live"] = all(
+        s in prom for s in ("samples_quarantined_total",
+                            "source_retries_total" if read_fault_prob
+                            else "stream_records_total",
+                            "sample_age_seconds", "stream_buffer_depth",
+                            "stream_records_total"))
+    evs = [e for e in _journal.recent() if e.get("ts", 0) >= t0]
+    summary["events"] = {k: sum(1 for e in evs if e.get("event") == k)
+                         for k in ("fault", "source_retry", "source_lost",
+                                   "sample_quarantined", "stream_seek",
+                                   "preempt", "stream_epoch")}
+    summary["ok"] = bool(
+        summary["byte_identical"] and summary["dead_letters_attributed"]
+        and summary["metrics_live"] and summary["preempted"] is not None
+        and summary["resumed"])
+    return summary
+
+
+def _fmt_stream(summary: dict, out=None):
+    out = out or sys.stdout
+    print(f"stream chaos: {summary['steps_completed']}/{summary['steps']} "
+          f"steps -> {'OK' if summary['ok'] else 'FAILED'}", file=out)
+    p = summary["preempted"]
+    if p:
+        print(f"  preempted at step {p['step']} (emergency checkpoint "
+              f"step {p['saved_step']}); resumed at "
+              f"{summary.get('resume_start_step')}", file=out)
+    ev = summary["events"]
+    print(f"  source retries: {ev['source_retry']}; quarantined "
+          f"{ev['sample_quarantined']} of {summary['poison_lines']} "
+          f"poison line(s); seeks: {ev['stream_seek']}", file=out)
+    print(f"  byte-identical resume: {summary['byte_identical']}; "
+          f"dead letters attributed: {summary['dead_letters_attributed']}; "
+          f"metrics live: {summary['metrics_live']}", file=out)
+
+
 def _rank0_record(log_dir: str, attempt: int) -> Optional[dict]:
     """Parse rank 0's ``ELASTIC_RUN`` record of one launch attempt."""
     name = "rank0.log" if attempt == 0 else f"rank0.attempt{attempt}.log"
@@ -466,8 +688,61 @@ def selftest() -> int:
     # 3. elastic machinery (reshard plan round trip, batch re-planning,
     # shrink-vs-wait policy) -- device-free, no subprocesses
     _selftest_elastic()
+
+    # 4. streaming data plane: flaky source + poison burst + mid-stream
+    # preempt/resume, hermetic (FakeClock, seeded faults, no sleeps)
+    _selftest_stream()
     print("chaos selftest: OK")
     return 0
+
+
+def _selftest_stream():
+    """Hermetic streaming-ingestion chaos: the ISSUE-14 acceptance leg.
+    Seeded stream + exc@read(p=0.25) + interleaved poison lines +
+    preemption mid-stream; asserts byte-identical resume, attributed
+    dead letters, and live quarantine/retry/freshness series."""
+    import shutil
+    import tempfile
+
+    from . import faults as _faults
+    from . import recovery as _recovery
+
+    # stream fault spec grammar
+    fs = _faults.parse_spec(
+        "exc@read:prob=0.1:seed=3:times=0;corrupt@read:step=4;hang@read")
+    assert [f.site for f in fs] == ["read", "read", "read"]
+    assert _faults.corrupt_record("x", "read") == "x"   # disarmed = no-op
+    for inert in ("nan@read", "truncate@parse"):    # no hook consumes
+        try:
+            _faults.parse_spec(inert)
+        except _faults.FaultSpecError:
+            pass
+        else:
+            raise AssertionError(f"{inert!r} should be rejected")
+
+    td = tempfile.mkdtemp(prefix="paddle_tpu_streamself_")
+    _faults.clear()
+    _recovery.clear_preemption()
+    try:
+        summary = run_stream_chaos(
+            steps=10, batch=3, dim=4, seed=7, poison_rate=0.12,
+            read_fault_prob=0.25, preempt_step=4, work_dir=td,
+            save_interval=3, hermetic=True)
+        assert summary["ok"], summary
+        assert summary["steps_completed"] == 10, summary
+        assert summary["preempted"] is not None and summary["resumed"], \
+            summary
+        assert summary["byte_identical"], summary
+        assert summary["poison_lines"] > 0 and \
+            summary["dead_letters_attributed"], summary
+        assert summary["metrics_live"], summary
+        assert summary["events"]["source_retry"] >= 1, summary
+        assert summary["events"]["stream_seek"] >= 1, summary
+    finally:
+        _faults.clear()
+        _recovery.clear_preemption()
+        shutil.rmtree(td, ignore_errors=True)
+    assert not _faults.armed()
 
 
 def main(argv=None) -> int:
@@ -507,10 +782,40 @@ def main(argv=None) -> int:
     ap.add_argument("--no-compare", action="store_true",
                     help="elastic mode: skip the byte-consistency "
                          "comparison run")
+    ap.add_argument("--stream", action="store_true",
+                    help="streaming data-plane chaos: flaky source + "
+                         "poison burst + mid-stream preempt against a "
+                         "StreamingDataset, byte-identical-resume "
+                         "verdict (paddle_tpu/data/streaming.py)")
+    ap.add_argument("--poison-rate", type=float, default=0.05,
+                    help="stream mode: malformed-line rate interleaved "
+                         "into the synthetic stream")
+    ap.add_argument("--read-fault-prob", type=float, default=0.1,
+                    help="stream mode: per-record exc@read probability")
+    ap.add_argument("--preempt-step", type=int, default=None,
+                    help="stream mode: preemption step (default: mid)")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.stream:
+        try:
+            summary = run_stream_chaos(
+                steps=args.steps, batch=args.batch, dim=args.dim,
+                seed=args.seed, poison_rate=args.poison_rate,
+                read_fault_prob=args.read_fault_prob,
+                preempt_step=args.preempt_step, work_dir=args.ckpt,
+                hermetic=False)
+        except Exception as e:  # noqa: BLE001 -- CLI boundary
+            print(f"stream chaos run failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.format == "json":
+            print(json.dumps(summary, indent=2, sort_keys=True,
+                             default=str))
+        else:
+            _fmt_stream(summary)
+        return 0 if summary["ok"] else 1
     if args.ranks:
         try:
             summary = run_elastic_chaos(

@@ -26,6 +26,11 @@ Spec grammar (entries separated by ``;``)::
                                           # fails typed (breaker chaos)
     hang@serve_hang:seconds=5             # wedge one serving worker
     nan@serve_fetch:var=evil:times=0      # NaN that tenant's batch outputs
+    exc@read:prob=0.1:seed=7:times=0      # flaky stream source (each read
+                                          # fails with prob 0.1; the source
+                                          # retry/backoff path reconnects)
+    corrupt@read:step=12                  # garble record index 12 (0-based)
+                                          # into a poison line (quarantine)
 
 Kinds: ``nan`` (also ``value=inf|-inf|<float>``), ``exc``, ``hang``,
 ``preempt``, ``kill`` (hard ``SIGKILL``/``os._exit`` of the current rank
@@ -33,17 +38,25 @@ Kinds: ``nan`` (also ``value=inf|-inf|<float>``), ``exc``, ``hang``,
 exit code), ``corrupt``, ``truncate``.  Sites: ``compile``, ``dispatch``,
 ``fetch``, ``checkpoint_write`` (``nan`` ignores the training site -- it
 corrupts the step's outputs/state by tensor name; ``corrupt``/``truncate``
-only make sense at ``checkpoint_write``, where they damage the files the
-save just wrote -- see :func:`mutate_checkpoint`), plus the serving-tier
+at ``checkpoint_write`` damage the files the save just wrote -- see
+:func:`mutate_checkpoint`), the serving-tier
 sites ``serve_dispatch`` (inside a batch: an ``exc`` here fails that
 batch's requests typed, a ``hang`` delays it), ``serve_fetch`` (between
 predictor run and de-slice; ``nan@serve_fetch`` overwrites the batch
 outputs -- see :func:`corrupt_serving`) and ``serve_hang`` (the worker
 loop outside any batch: a ``hang`` wedges the worker itself, an ``exc``
-kills the worker thread -- the crash-respawn chaos primitive).  Keys:
-``step`` (program step index / serving batch sequence, omit = every
-step), ``var`` (tensor name at training sites; at ``serve_*`` sites a
-TENANT name -- the fault only fires on batches carrying that tenant),
+kills the worker thread -- the crash-respawn chaos primitive), plus the
+data-plane sites ``read`` (a streaming source delivering one record: an
+``exc`` is a transient source failure the retry/reconnect path must
+absorb, a ``hang`` is a stalled feed, a ``corrupt`` garbles the record
+text into a poison line -- see :func:`corrupt_record`) and ``parse``
+(the line parser: ``corrupt@parse`` garbles the line at parse time,
+``exc@parse`` fails the parse -- both land in the quarantine path).
+Keys: ``step`` (program step index / serving batch sequence / stream
+record index at ``read``/``parse``, omit = every step), ``var`` (tensor
+name at training sites; at ``serve_*`` sites a TENANT name -- the fault
+only fires on batches carrying that tenant; at ``read``/``parse`` a
+SOURCE name),
 ``times`` (total fires, default 1 so a rolled-back step does not re-trip
 the same fault forever; 0 = unlimited), ``seconds`` (hang duration),
 ``prob`` + ``seed`` (seeded Bernoulli draw per match -- deterministic
@@ -70,10 +83,13 @@ ENV_VAR = "PADDLE_TPU_FAULTS"
 
 KINDS = ("nan", "exc", "hang", "preempt", "kill", "corrupt", "truncate")
 SITES = ("compile", "dispatch", "fetch", "checkpoint_write",
-         "serve_dispatch", "serve_fetch", "serve_hang")
+         "serve_dispatch", "serve_fetch", "serve_hang", "read", "parse")
 #: sites fired from the serving tier (PredictorPool workers); ``var`` at
 #: these sites names a tenant, not a tensor
 SERVING_SITES = ("serve_dispatch", "serve_fetch", "serve_hang")
+#: sites fired from the streaming data plane (paddle_tpu/data/); ``var``
+#: names a source, ``step`` is the per-source record index
+STREAM_SITES = ("read", "parse")
 _DEFAULT_SITE = {"nan": "fetch", "exc": "dispatch", "hang": "fetch",
                  "preempt": "dispatch", "kill": "dispatch",
                  "corrupt": "checkpoint_write",
@@ -128,6 +144,12 @@ class Fault:
                 f"unknown fault site {self.site!r}; use one of {SITES}")
         if not (0.0 < self.prob <= 1.0):
             raise FaultSpecError(f"prob must be in (0, 1], got {self.prob}")
+        if self.site in STREAM_SITES and self.kind in ("nan", "truncate"):
+            # no stream hook consumes these kinds: arming one would report
+            # a clean chaos run in which nothing was ever injected
+            raise FaultSpecError(
+                f"kind {self.kind!r} has no hook at stream site "
+                f"{self.site!r}; use exc/hang/corrupt (or kill/preempt)")
         # per-fault seeded stream: two prob-faults never share draws, and a
         # given (seed, match sequence) always fires at the same steps
         self._rng = random.Random(self.seed)
@@ -400,6 +422,30 @@ def corrupt_serving(outputs, step: Optional[int] = None,
                     "detail": "no float serving output to corrupt; "
                               "fault not consumed"})
     return outs
+
+
+def corrupt_record(text: str, site: str = "read",
+                   step: Optional[int] = None,
+                   tags: Optional[Sequence[str]] = None) -> str:
+    """Hook point: apply armed ``corrupt@read``/``corrupt@parse`` faults to
+    one stream record's text (called by the streaming source reader /
+    line parser only when faults are armed).  The garbled line fails slot
+    parsing, so it exercises the poison-record quarantine path end to
+    end; ``var`` narrows the fault to one source (via ``tags``), ``step``
+    to a record index.  Deterministic: the mangled text depends only on
+    the input."""
+    if not _active:
+        return text
+    for f in _active:
+        if f.kind != "corrupt" or f.site not in STREAM_SITES \
+                or not f.matches(site, step, tags):
+            continue
+        _record(f, site, step, var=f.var)
+        # un-parseable under any slot count, and visibly marked in the
+        # dead-letter file: drop every separator and append a tag
+        text = ("\x7fCORRUPT\x7f " +
+                text.replace(";", " ").strip() + " ;;;")
+    return text
 
 
 def mutate_checkpoint(dirname, step: Optional[int] = None) -> List[dict]:

@@ -253,6 +253,11 @@ class StepGuardian:
         self._ring: "collections.deque[_Snapshot]" = collections.deque(
             maxlen=max(1, snapshot_ring))
         self._last_snap_step: Optional[int] = None
+        # dataset position staged by train_from_dataset for the step ABOUT
+        # to run; applied to the checkpointer only after that step commits
+        # (an emergency save at the pre-step boundary must persist the
+        # LAST COMPLETED position, not the one that never ran)
+        self._pending_state: Optional[dict] = None
         self._closed = False
         if handle_signals is None:
             handle_signals = checkpointer is not None
@@ -270,6 +275,10 @@ class StepGuardian:
         from ..framework import default_main_program
         program = program or self.program or default_main_program()
         scope = scope or self.scope or global_scope()
+        # take ownership of the staged dataset position NOW: if this step
+        # raises (preemption, terminal error), the stale doc must never
+        # be committed by a later, unrelated run() call
+        pending_state = self._take_pending_state()
         if _preempt.is_set():
             self._emergency_exit()  # raises Preempted
         if self.nonfinite_policy != "raise" and self._snapshot_due():
@@ -318,6 +327,7 @@ class StepGuardian:
             fetches = self._apply_nonfinite_policy(bad, program, scope,
                                                    fetches)
         self.step += 1
+        self._commit_train_state(pending_state)
         if self.checkpointer is not None:
             self._checkpoint_with_retry(self.checkpointer.maybe_save,
                                         self.step - 1)
@@ -352,6 +362,7 @@ class StepGuardian:
             k = len(feeds or ())
         if k < 1:
             raise ValueError("run_fused needs at least one feed")
+        pending_state = self._take_pending_state()
         if _preempt.is_set():
             self._emergency_exit()  # raises Preempted
         if self.nonfinite_policy != "raise" and self._snapshot_due():
@@ -395,6 +406,7 @@ class StepGuardian:
             fetches = self._apply_nonfinite_policy(bad, program, scope,
                                                    fetches)
         self.step += k
+        self._commit_train_state(pending_state)
         if self.checkpointer is not None:
             self._checkpoint_with_retry(self.checkpointer.maybe_save,
                                         self.step - 1)
@@ -403,7 +415,7 @@ class StepGuardian:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, fetch_list=None,
                            fuse_steps: int = 1, skip_batches: int = 0,
-                           epoch: int = 0, **kw):
+                           epoch: int = 0, step_cb=None, **kw):
         """One guarded epoch over a Dataset (each batch through
         :meth:`run`, prefetched like ``Executor.train_from_dataset``).
 
@@ -412,26 +424,39 @@ class StepGuardian:
         -- documented skip/rollback granularity becomes K steps.
         ``fuse_steps=0`` consults the autotuner's cached ``fuse_steps.k``
         decision (the guardian never searches: measurement belongs to the
-        unguarded loop).
+        unguarded loop).  ``step_cb(batches_consumed, fetches)`` is
+        invoked after every guarded chunk (per-step loss collection
+        without materializing more than the caller asks for).
 
         Exact resume: the attached checkpointer's ``trainstate.json``
-        records, before every guarded step, the batch position the save
-        at that step boundary corresponds to (``epoch``, ``batch`` =
-        batches consumed including the step being run, ``fuse_steps``).
-        ``skip_batches=N`` fast-forwards a restored run past the batches
-        the checkpoint already consumed::
+        records, for every guarded step, the batch position the save at
+        that step boundary corresponds to (``epoch``, ``batch`` = batches
+        consumed including the step that just ran, ``fuse_steps``) --
+        staged when the chunk arrives, committed only after the step
+        lands, so an emergency preemption save never persists the
+        position of a step that never ran.  ``skip_batches=N``
+        fast-forwards a restored run past the batches the checkpoint
+        already consumed::
 
             start = ck.restore() + 1
             pos = ck.train_state or {}
             g.train_from_dataset(dataset=ds, fuse_steps=k,
                                  epoch=pos.get("epoch", 0),
                                  skip_batches=pos.get("batch", 0))
-        """
+
+        A streaming dataset (``paddle_tpu.data.StreamingDataset``)
+        additionally rides its per-source watermark in the same document
+        (``stream`` key, from ``dataset.watermark(batch)``): restore with
+        ``ds.seek(ck.train_state["stream"])`` instead of
+        ``skip_batches``."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
         depth = self.exe._prefetch_depth(thread, dataset)
         k = int(fuse_steps)
         batches = dataset._iter_batches()
+        # the stream-abort hook, captured before islice/chain wrapping
+        # can hide it from the prefetch loop's wind-down
+        abort_cb = getattr(batches, "abort", None)
         if skip_batches:
             import itertools
             batches = itertools.islice(batches, skip_batches, None)
@@ -440,13 +465,21 @@ class StepGuardian:
                 batches, fetch_list or [])
         consumed = int(skip_batches)
         mark = getattr(self.checkpointer, "update_train_state", None)
+        wm = getattr(dataset, "watermark", None)
 
         def _mark(n_after: int):
-            # recorded BEFORE the step runs: maybe_save fires inside
-            # run()/run_fused() right after the state commits, and the
-            # position it must persist is "this chunk consumed"
-            if mark is not None:
-                mark(epoch=int(epoch), batch=n_after, fuse_steps=k)
+            # STAGED before the step runs, committed by run()/run_fused()
+            # after the state lands (see _commit_train_state): the
+            # position a save persists is "this chunk consumed", and a
+            # pre-step emergency exit keeps the previous one
+            if mark is None:
+                return
+            st = {"epoch": int(epoch), "batch": n_after, "fuse_steps": k}
+            if wm is not None:
+                doc = wm(n_after)
+                if doc is not None:
+                    st["stream"] = doc
+            self._pending_state = st
         if k > 1:
             from ..framework import Program as _Program
             from ..framework import default_main_program
@@ -463,7 +496,8 @@ class StepGuardian:
                 k = 1
         last = None
         if k > 1:
-            for item in self.exe._prefetch_batches(batches, depth, fuse=k):
+            for item in self.exe._prefetch_batches(batches, depth, fuse=k,
+                                                   abort=abort_cb):
                 if item[0] == "mega":
                     _mark(consumed + item[2])
                     last = self.run_fused(program, stacked_feed=item[1],
@@ -476,12 +510,17 @@ class StepGuardian:
                                     fetch_list=fetch_list, scope=scope,
                                     **kw)
                     consumed += 1
+                if step_cb is not None:
+                    step_cb(consumed, last)
         else:
-            for feed in self.exe._prefetch_batches(batches, depth):
+            for feed in self.exe._prefetch_batches(batches, depth,
+                                                   abort=abort_cb):
                 _mark(consumed + 1)
                 last = self.run(program, feed=feed, fetch_list=fetch_list,
                                 scope=scope, **kw)
                 consumed += 1
+                if step_cb is not None:
+                    step_cb(consumed, last)
         return last
 
     def close(self):
@@ -598,6 +637,19 @@ class StepGuardian:
                            "vars": bad[:8], "to_step": to_step,
                            "source": source})
         return fetches
+
+    def _take_pending_state(self):
+        """Pop the dataset position ``train_from_dataset`` staged for the
+        step about to run: the step that takes it either commits it on
+        success or drops it on failure -- never a later unrelated run."""
+        pending, self._pending_state = self._pending_state, None
+        return pending
+
+    def _commit_train_state(self, pending):
+        """Apply the staged dataset position to the checkpointer, now
+        that the step it described has landed."""
+        if pending is not None and self.checkpointer is not None:
+            self.checkpointer.update_train_state(**pending)
 
     def _snapshot_due(self) -> bool:
         return (self._last_snap_step is None or

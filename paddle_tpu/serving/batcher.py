@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..tuning.choices import pow2_bucket
+from ..utils.clock import Clock, FakeClock, MonotonicClock
 
 __all__ = [
     "ServingError", "RequestShed", "RequestTimeout", "Clock",
@@ -86,46 +87,9 @@ class RequestTimeout(ServingError):
             f"{waited_ms:.1f}ms of a {deadline_ms:.1f}ms budget")
 
 
-# ------------------------------------------------------------------ clocks --
-
-class Clock:
-    """Time + condition-wait seam; the batcher never calls time/sleep
-    directly so tests can substitute a fake."""
-
-    def now(self) -> float:
-        raise NotImplementedError
-
-    def wait(self, cond: threading.Condition, timeout: float) -> None:
-        """Wait on ``cond`` (held by the caller) up to ``timeout`` secs."""
-        raise NotImplementedError
-
-
-class MonotonicClock(Clock):
-    def now(self) -> float:
-        import time
-        return time.monotonic()
-
-    def wait(self, cond, timeout):
-        cond.wait(timeout)
-
-
-class FakeClock(Clock):
-    """Deterministic clock for hermetic batcher tests: ``wait`` advances
-    time instead of sleeping, so deadline paths run in microseconds."""
-
-    def __init__(self, t: float = 0.0):
-        self.t = float(t)
-        self.waits: List[float] = []
-
-    def now(self) -> float:
-        return self.t
-
-    def advance(self, dt: float) -> None:
-        self.t += dt
-
-    def wait(self, cond, timeout):
-        self.waits.append(timeout)
-        self.t += max(0.0, timeout)
+# clocks: the Clock/MonotonicClock/FakeClock seam moved to
+# paddle_tpu/utils/clock.py (shared with the streaming data plane);
+# imported above and kept in this namespace for the published serving API.
 
 
 # ---------------------------------------------------------------- requests --

@@ -359,3 +359,124 @@ def test_queue_dataset_streaming_matches_eager(tmp_path):
             for bq, bm in zip(q, m):
                 np.testing.assert_array_equal(bq["ids"], bm["ids"])
                 np.testing.assert_array_equal(bq["label"], bm["label"])
+
+
+def test_read_files_mixed_format_demotion(tmp_path, monkeypatch):
+    """ISSUE 14 satellite: pin the mixed native/columnar demotion path in
+    DatasetBase._read_files (dataset_factory.py) -- a columnar-parsed
+    prefix followed by a Python-parsed file must demote to rows with no
+    samples lost or reordered.  The native parser is simulated so the pin
+    holds whether or not the native library is present."""
+    from paddle_tpu.dataset_factory import DatasetBase
+
+    x = fluid.Program()
+    with fluid.program_guard(x, fluid.Program()):
+        ids = fluid.data("ids", [2], "float32")
+
+    paths = []
+    for fi, rows in enumerate([(0, 1, 2), (3, 4), (5, 6, 7)]):
+        p = tmp_path / f"part-{fi}.txt"
+        with open(p, "w") as f:
+            for r in rows:
+                f.write(f"{r} {r + 0.5}\n")
+        paths.append(str(p))
+
+    real_read_native = DatasetBase._read_native
+
+    def fake_native(self, path):
+        # files 0 and 2 parse "natively" (columnar [N, 2] matrices),
+        # file 1 falls back to the Python line parser
+        if path.endswith("part-1.txt"):
+            return None
+        rows = [[float(v) for v in ln.split()]
+                for ln in open(path) if ln.strip()]
+        return [np.asarray(rows, dtype="float32")]
+
+    monkeypatch.setattr(DatasetBase, "_read_native", fake_native)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(3)
+    ds.set_use_var([ids])
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    # demoted to a row list (file 1 broke the columnar run), all 8 rows
+    # present in file order
+    assert ds.get_memory_data_size() == 8
+    assert not ds._is_columnar(ds._samples)
+    got = np.concatenate([b["ids"] for b in ds._iter_batches()])
+    np.testing.assert_allclose(got[:, 0], np.arange(8, dtype="float32"))
+
+    # all-native stays columnar (the fast path is not regressed)
+    monkeypatch.setattr(DatasetBase, "_read_native", fake_native)
+    ds2 = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds2.set_batch_size(3)
+    ds2.set_use_var([ids])
+    ds2.set_filelist([paths[0], paths[2]])
+    ds2.load_into_memory()
+    assert ds2._is_columnar(ds2._samples)
+    monkeypatch.setattr(DatasetBase, "_read_native", real_read_native)
+
+
+def test_on_missing_file_policy(tmp_path):
+    """ISSUE 14 satellite: on_missing_file='skip' keeps the multi-file
+    load alive (journaled source_skipped), default 'raise' preserves the
+    historical abort; a skipped LAST file still flushes the streaming
+    remainder."""
+    from paddle_tpu.observability import journal
+
+    x = fluid.Program()
+    with fluid.program_guard(x, fluid.Program()):
+        ids = fluid.data("ids", [1], "float32")
+    present = tmp_path / "ok.txt"
+    with open(present, "w") as f:
+        f.write("1\n2\n3\n")
+    gone = str(tmp_path / "gone.txt")
+
+    for cls in ("InMemoryDataset", "QueueDataset"):
+        ds = fluid.DatasetFactory().create_dataset(cls)
+        ds.set_batch_size(2)
+        ds.set_use_var([ids])
+        ds.set_filelist([str(present), gone])
+        with pytest.raises(FileNotFoundError):
+            (ds.load_into_memory() if cls == "InMemoryDataset"
+             else list(ds._iter_batches()))
+
+        ds2 = fluid.DatasetFactory().create_dataset(cls)
+        ds2.set_batch_size(2)
+        ds2.set_use_var([ids])
+        ds2.set_filelist([str(present), gone])   # missing LAST file
+        ds2.set_missing_file_policy("skip")
+        if cls == "InMemoryDataset":
+            ds2.load_into_memory()
+        batches = list(ds2._iter_batches())
+        # 3 rows -> [2, 1]: the remainder flushed despite the skipped tail
+        assert [b["ids"].shape[0] for b in batches] == [2, 1], cls
+    assert any(e.get("event") == "source_skipped"
+               for e in journal.recent())
+    with pytest.raises(ValueError):
+        ds2.set_missing_file_policy("bogus")
+
+
+def test_parse_error_carries_source_position(tmp_path):
+    """ISSUE 14 satellite: a slot-count mismatch (and a value parse
+    failure) names the offending file:line."""
+    x = fluid.Program()
+    with fluid.program_guard(x, fluid.Program()):
+        ids = fluid.data("ids", [1], "float32")
+        lab = fluid.data("lab", [1], "int64")
+    p = tmp_path / "bad.txt"
+    with open(p, "w") as f:
+        f.write("1;0\n2;0;9\n")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(1)
+    ds.set_use_var([ids, lab])
+    ds.set_filelist([str(p)])
+    with pytest.raises(ValueError, match=r"bad\.txt:2"):
+        list(ds._iter_batches())
+    with open(p, "w") as f:
+        f.write("notafloat;0\n")
+    ds2 = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds2.set_batch_size(1)
+    ds2.set_use_var([ids, lab])
+    ds2.set_filelist([str(p)])
+    with pytest.raises(ValueError, match=r"bad\.txt:1"):
+        list(ds2._iter_batches())

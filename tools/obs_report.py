@@ -368,6 +368,103 @@ def render_serving(events: Optional[List[dict]],
     return "\n".join(lines)
 
 
+# -------------------------------------------------------------- ingestion --
+
+_INGEST_EVENTS = ("source_retry", "source_lost", "sample_quarantined",
+                  "stream_seek", "stream_seek_gap", "source_skipped",
+                  "stream_epoch", "stream_torn_tail")
+
+
+def render_ingestion(events: Optional[List[dict]],
+                     snapshot: Optional[dict] = None) -> str:
+    """Streaming data-plane activity (paddle_tpu/data/ + the shared
+    dataset quarantine policy): source retries/losses, poison-record
+    quarantine rate, stream seeks, sample freshness p50/p99 and buffer
+    depth."""
+    lines = ["== Ingestion =="]
+    events = events or []
+    by = {k: [e for e in events if e.get("event") == k]
+          for k in _INGEST_EVENTS}
+    fams = {f.get("name"): f for f in (snapshot or {}).get("families", [])}
+    if not any(by.values()) and "stream_records_total" not in fams \
+            and "samples_quarantined_total" not in fams:
+        lines.append("quiet: no streaming-ingestion activity (run a "
+                     "paddle_tpu.data.StreamingDataset or "
+                     "python -m paddle_tpu.resilience --stream)")
+        return "\n".join(lines)
+    ep = by["stream_epoch"][-1] if by["stream_epoch"] else None
+    if ep is not None:
+        lines.append(f"last stream epoch: {ep.get('batches')} batch(es), "
+                     f"{ep.get('records')} record(s) consumed, "
+                     f"{ep.get('dead_letters')} dead-letter(s); "
+                     f"watermarks {ep.get('sources')}")
+    n_rec = _counter_total(snapshot, "stream_records_total")
+    if n_rec:
+        lines.append(f"records ingested: {n_rec:g}")
+    if by["source_retry"]:
+        srcs = {}
+        for e in by["source_retry"]:
+            k = str(e.get("source", "?"))
+            srcs[k] = srcs.get(k, 0) + 1
+        lines.append(f"{len(by['source_retry'])} source retr(ies): " +
+                     ", ".join(f"{s} x{n}" for s, n in sorted(srcs.items())))
+        for e in by["source_retry"][-5:]:
+            lines.append(f"  retry {e.get('source')} attempt "
+                         f"{e.get('attempt')} (backoff "
+                         f"{e.get('backoff_ms')}ms): "
+                         f"{str(e.get('error', ''))[:80]}")
+    for e in by["source_lost"][-5:]:
+        lines.append(f"SOURCE LOST {e.get('source')} after "
+                     f"{e.get('attempts')} attempt(s): "
+                     f"{str(e.get('error', ''))[:80]}")
+    n_quar = _counter_total(snapshot, "samples_quarantined_total")
+    if n_quar or by["sample_quarantined"]:
+        n_q = n_quar if n_quar else float(len(by["sample_quarantined"]))
+        rate = f" ({n_q / n_rec:.2%} of ingested)" if n_rec else ""
+        reasons = {}
+        for s in fams.get("samples_quarantined_total",
+                          {}).get("samples", []):
+            reasons[s.get("labels", {}).get("reason", "?")] = \
+                s.get("value", 0.0)
+        det = (" by reason: " + ", ".join(
+            f"{r} x{int(n)}" for r, n in sorted(reasons.items()))
+            if reasons else "")
+        lines.append(f"quarantine rate: {n_q:g} sample(s){rate}{det}")
+        for e in by["sample_quarantined"][-5:]:
+            lines.append(f"  QUARANTINED {e.get('where')} "
+                         f"({e.get('reason')}): "
+                         f"{str(e.get('error', ''))[:80]} -> "
+                         f"{e.get('dead_letter')}")
+    for e in by["stream_seek"][-3:]:
+        lines.append(f"stream seek -> {e.get('sources')} "
+                     f"(records {e.get('records')}, dead letters "
+                     f"{e.get('dead_letters')})")
+    for e in by["stream_seek_gap"][-3:]:
+        lines.append(f"SEEK GAP {e.get('source')}: "
+                     f"{str(e.get('detail', ''))[:90]}")
+    for e in by["stream_torn_tail"][-3:]:
+        lines.append(f"TORN TAIL {e.get('source')} at byte "
+                     f"{e.get('pos')}: {str(e.get('detail', ''))[:80]}")
+    if by["source_skipped"]:
+        lines.append(f"{len(by['source_skipped'])} missing file(s) "
+                     f"skipped (on_missing_file=skip): "
+                     f"{[e.get('file') for e in by['source_skipped']][-5:]}")
+    for s in fams.get("sample_age_seconds", {}).get("samples", []):
+        n = s.get("count", 0)
+        if not n:
+            continue
+        p50 = _hist_quantile(s.get("buckets", []), 0.5)
+        p99 = _hist_quantile(s.get("buckets", []), 0.99)
+        fmt = lambda v: ("?" if v is None else "inf" if math.isinf(v)
+                         else f"{v * 1e3:.4g}ms")
+        mean = s.get("sum", 0.0) / n
+        lines.append(f"sample freshness: n={n} mean={mean * 1e3:.4g}ms "
+                     f"p50<={fmt(p50)} p99<={fmt(p99)}")
+    for s in fams.get("stream_buffer_depth", {}).get("samples", []):
+        lines.append(f"buffer depth now: {s.get('value', 0.0):g}")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- megastep --
 
 def _counter_total(snapshot: Optional[dict], name: str) -> Optional[float]:
@@ -648,6 +745,7 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_resilience(events))
         parts.append(render_checkpoint(events, snapshot))
         parts.append(render_serving(events, snapshot))
+        parts.append(render_ingestion(events, snapshot))
     if goodput:
         parts.append(render_goodput(events, snapshot))
     if fleet:
@@ -722,6 +820,13 @@ def selftest() -> int:
     reg.gauge("serving_breaker_state", tenant="evil", sig="00c0ffee").set(2)
     reg.gauge("serving_model_version").set(2)
     reg.counter("serving_worker_crash_total").inc()
+    # ingestion section sources (paddle_tpu/data/ streaming, ISSUE 14)
+    reg.counter("stream_records_total").inc(120)
+    reg.counter("samples_quarantined_total", reason="slot_count").inc(3)
+    reg.counter("source_retries_total", source="clicks").inc(2)
+    reg.gauge("stream_buffer_depth").set(7)
+    for v in (0.003, 0.005, 0.011):
+        reg.histogram("sample_age_seconds").observe(v)
 
     events = [
         {"event": "run", "program": 1, "version": 0, "cache": "miss",
@@ -802,6 +907,22 @@ def selftest() -> int:
          "error": "TransientFault: UNAVAILABLE: injected", "ts": 9.95},
         {"event": "serve_drain_timeout", "failed_queued": 2,
          "failed_in_flight": 1, "waited_s": 0.4, "ts": 9.96},
+        # ingestion section (streaming data plane, ISSUE 14)
+        {"event": "source_retry", "source": "clicks", "attempt": 1,
+         "backoff_ms": 40.0, "error": "UNAVAILABLE: injected transient "
+         "fault at read", "ts": 9.961},
+        {"event": "sample_quarantined", "where": "clicks:418",
+         "reason": "slot_count", "error": "line at clicks:418 has 3 "
+         "slots but set_use_var lists 1 vars",
+         "dead_letter": "dead.jsonl", "ts": 9.962},
+        {"event": "source_lost", "source": "flaky", "attempts": 5,
+         "error": "ConnectionResetError: peer reset", "ts": 9.963},
+        {"event": "stream_seek", "sources": {"clicks": 1024},
+         "records": 36, "dead_letters": 3, "ts": 9.964},
+        {"event": "source_skipped", "file": "part-00007.txt",
+         "ts": 9.965},
+        {"event": "stream_epoch", "batches": 12, "records": 36,
+         "dead_letters": 3, "sources": {"clicks": 2048}, "ts": 9.966},
     ]
 
     # a synthetic flight-recorder trace through the real exporter
@@ -885,6 +1006,23 @@ def selftest() -> int:
                      "CRASH worker 1: TransientFault",
                      "DRAIN TIMEOUT after 0.4s: 2 queued + 1 in-flight "
                      "failed typed",
+                     # ingestion section (ISSUE 14)
+                     "== Ingestion ==",
+                     "last stream epoch: 12 batch(es), 36 record(s) "
+                     "consumed, 3 dead-letter(s)",
+                     "records ingested: 120",
+                     "1 source retr(ies): clicks x1",
+                     "retry clicks attempt 1 (backoff 40.0ms)",
+                     "SOURCE LOST flaky after 5 attempt(s)",
+                     "quarantine rate: 3 sample(s) (2.50% of ingested) "
+                     "by reason: slot_count x3",
+                     "QUARANTINED clicks:418 (slot_count)",
+                     "stream seek -> {'clicks': 1024} (records 36, "
+                     "dead letters 3)",
+                     "1 missing file(s) skipped (on_missing_file=skip): "
+                     "['part-00007.txt']",
+                     "sample freshness: n=3",
+                     "buffer depth now: 7",
                      # goodput section (wall-clock ledger)
                      "== Goodput ==", "-> goodput",
                      "dispatch + fetch_sync", "lost compile",
@@ -908,6 +1046,7 @@ def selftest() -> int:
         assert "quiet" in render_resilience([])
         assert "quiet" in render_checkpoint([])
         assert "idle" in render_serving([])
+        assert "quiet" in render_ingestion([])
         assert "unfused" in render_megastep([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})

@@ -34,6 +34,7 @@ MODULES = [
     "paddle_tpu.analysis",
     "paddle_tpu.tuning",
     "paddle_tpu.resilience",
+    "paddle_tpu.data",
     "paddle_tpu.observability",
     "paddle_tpu.serving",
     "paddle_tpu.utils.checkpointer",
