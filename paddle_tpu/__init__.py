@@ -41,6 +41,7 @@ from . import analysis  # noqa: F401  (static program verifier)
 from . import resilience  # noqa: F401  (fault injection + step recovery)
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
+from . import comm  # noqa: F401  (quantized collectives + reshard planner)
 from . import average  # noqa: F401
 from . import install_check  # noqa: F401
 from . import net_drawer  # noqa: F401

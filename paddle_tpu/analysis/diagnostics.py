@@ -98,13 +98,18 @@ CODES: Dict[str, tuple] = {
                               "product of its mesh axis sizes"),
     "PT046": (Severity.WARN, "strategy forces a per-step re-gather: "
                              "ZeRO-sharded params are all-gathered at every "
-                             "use (or stay replicated, losing the memory "
-                             "win)"),
+                             "use (priced with the comm.plan_transfer "
+                             "collective plan) or stay replicated, losing "
+                             "the memory win"),
     "PT047": (Severity.WARN, "strategy pins an assumption that breaks "
                              "under an elastic resize: a data var's batch "
                              "dim is hardcoded to a multiple of the "
                              "current world size; a resized world that "
                              "does not divide it will reject every feed"),
+    "PT048": (Severity.WARN, "comm_compression=int8 is set but a gradient "
+                             "dtype is outside the quantizer's support; "
+                             "that tensor silently falls back to the "
+                             "uncompressed allreduce"),
     # -- static memory planning (memplan.py) -------------------------------
     "PT050": (Severity.INFO, "static peak-memory estimate for the program "
                              "(liveness over the IR, sharding divisors and "

@@ -144,6 +144,7 @@ class DistributedPass(AnalysisPass):
             self._check_sharding(ctx, diags)
             self._check_regather(ctx, diags)
             self._check_elastic(ctx, diags)
+            self._check_compression(ctx, diags)
         return diags
 
     # ------------------------------------------------------------ PT041 --
@@ -345,6 +346,53 @@ class DistributedPass(AnalysisPass):
                                  f"resize freely",
                         block_idx=b.idx, var=n))
 
+    # ------------------------------------------------------------ PT048 --
+    def _check_compression(self, ctx, diags):
+        """int8 gradient compression with a gradient dtype the quantizer
+        does not support: the lowering silently falls back to the
+        uncompressed allreduce for that tensor -- surface it at lint time
+        so the missing bandwidth win is not a mystery."""
+        ds = ctx.strategy
+        if getattr(ds, "comm_compression", "off") != "int8":
+            return
+        from ..comm.compress import SUPPORTED_DTYPES
+        from ..comm.rewrite import SYNC_ATTR, optimizer_grad_vars
+        prog = ctx.program
+        gb = prog.global_block()
+        flagged = set()
+        # optimizer-consumed gradients (the vars the rewrite targets) ...
+        for _, g in optimizer_grad_vars(prog):
+            v = gb.find_var_recursive(g)
+            if v is not None and v.dtype not in SUPPORTED_DTYPES \
+                    and g not in flagged:
+                flagged.add(g)
+                diags.append(Diagnostic(
+                    "PT048", f"gradient {g!r} has dtype {v.dtype}, which "
+                             f"the int8 quantizer does not support "
+                             f"(supported: {list(SUPPORTED_DTYPES)}); it "
+                             f"will silently ride the uncompressed f32 "
+                             f"allreduce -- cast it, or expect no "
+                             f"bandwidth win for this tensor",
+                    block_idx=0, var=g))
+        # ... plus explicit allreduce ops the user wrote themselves
+        for b in prog.blocks:
+            for op in b.ops:
+                if op.type not in ("c_allreduce_sum", "c_allreduce_avg") \
+                        or op.attr(SYNC_ATTR):
+                    continue
+                for n in op.inputs.get("X", []):
+                    v = b.find_var_recursive(n)
+                    if v is not None and v.dtype not in SUPPORTED_DTYPES \
+                            and n not in flagged:
+                        flagged.add(n)
+                        diags.append(Diagnostic.for_op(
+                            "PT048", f"c_allreduce input {n!r} has dtype "
+                                     f"{v.dtype}, outside the int8 "
+                                     f"quantizer's support "
+                                     f"({list(SUPPORTED_DTYPES)}): it "
+                                     f"silently stays uncompressed",
+                            b, op, var=n))
+
     # ------------------------------------------------------------ PT046 --
     def _check_regather(self, ctx, diags):
         from ..compiler import BuildStrategy
@@ -364,28 +412,57 @@ class DistributedPass(AnalysisPass):
             return not any(spec_entries(ds.param_spec(n)))
 
         if getattr(bs, "reduce_params", False):
-            gathered, total = [], 0
+            from ..comm import cost as _comm_cost
+            from ..comm import reshard as _comm_reshard
+            from ..resilience.elastic import zero_shard_dim
+            gathered, total, wire_total = [], 0, 0
+            dp = ndp or 2
             for n, v in gb.vars.items():
                 if not isinstance(v, Parameter) or not replicated(n):
                     continue
-                dp = ndp or 2
-                if any(isinstance(s, int) and s > 0 and s % dp == 0
-                       for s in v.shape) or ndp is None:
+                # only params that will actually shard (a dp-divisible
+                # dim) are re-gathered; a non-divisible param stays
+                # replicated (the second PT046 branch covers that cost)
+                dim = zero_shard_dim(v.shape, dp)
+                if dim is not None:
                     nbytes = dtype_bytes(v.dtype)
                     for s in v.shape:
                         nbytes *= max(1, s)
-                    gathered.append((nbytes, n))
+                    # the concrete plan for this re-gather: the SAME
+                    # spec-to-spec decomposition the reshard lowering and
+                    # the elastic planner use (comm.plan_transfer)
+                    plan = _comm_reshard.plan_transfer(
+                        v.shape, v.dtype,
+                        _comm_reshard.ShardSpec(dim, dp),
+                        _comm_reshard.ShardSpec(None))
+                    gathered.append((nbytes, n, plan))
                     total += nbytes
+                    wire_total += plan.wire_bytes
             if gathered:
-                gathered.sort(reverse=True)
-                top = ", ".join(f"{n} ({b} B)" for b, n in gathered[:3])
+                gathered.sort(key=lambda t: (-t[0], t[1]))
+                top = ", ".join(f"{n} ({b} B)" for b, n, _ in gathered[:3])
+                plan0 = gathered[0][2]
+                mode = getattr(ds, "comm_compression", "off")
+                priced = (f"plan per param per step: "
+                          f"{plan0.summary()}; total wire "
+                          f"~{wire_total} B/device/step at dp={dp}"
+                          + (" (dp assumed 2: default mesh)"
+                             if ndp is None else ""))
+                if mode in ("bf16", "int8"):
+                    comp = sum(_comm_cost.wire_bytes(
+                        "all_gather",
+                        _comm_cost.compressed_bytes(b, "float32", mode, dp),
+                        dp) for b, _, _ in gathered)
+                    priced += (f"; compressed ({mode}) the same plan "
+                               f"ships ~{comp} B/device/step")
                 diags.append(Diagnostic(
                     "PT046", f"ReduceStrategy.Reduce + reduce_params "
                              f"shards {len(gathered)} parameter(s) over dp "
                              f"and GSPMD all-gathers each at every use: "
                              f"~{total} bytes re-gathered per device per "
-                             f"step (top: {top}); the memory win costs "
-                             f"this bandwidth every step", block_idx=0))
+                             f"step (top: {top}); {priced}; the memory "
+                             f"win costs this bandwidth every step",
+                    block_idx=0))
         if ndp is None:
             return
         stuck, stuck_bytes = [], 0

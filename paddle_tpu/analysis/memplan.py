@@ -148,6 +148,11 @@ def estimate_program_memory(program, feed_names: Optional[Sequence[str]] = None,
         if ds is None:
             return 1
         if v.persistable:
+            from ..comm.compress import is_residual
+            if is_residual(n):
+                # error-feedback residual (comm/rewrite.py): dp-sharded on
+                # its leading (ndp) dim -- per-device cost is 1/ndp
+                return max(1, int(sizes.get(ds.data_axis, 1)))
             spec = spec_entries(ds.param_spec(n))
             if len(spec) > v.ndim:
                 spec = []  # compiler replicates on rank mismatch
@@ -200,6 +205,12 @@ def estimate_program_memory(program, feed_names: Optional[Sequence[str]] = None,
              if n not in args and gb.find_var_recursive(n) is not None]
     arg_set = set(args)
     arg_bytes = sum(bytes_of(n) for n in args)
+    if ds is not None and getattr(ds, "comm_compression", "off") != "off":
+        # error-feedback residuals comm_compression will materialize at
+        # compile time (one per compressed gradient, 1/ndp per device);
+        # returns 0 once the rewrite has created the real vars above
+        from ..comm.rewrite import planned_residual_bytes
+        arg_bytes += planned_residual_bytes(program, ds, bs, batch=batch)
 
     last_read: Dict[str, int] = {}
     for i, rd in enumerate(reads_at):

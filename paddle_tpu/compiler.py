@@ -114,21 +114,39 @@ class DistributedStrategy:
     param_rules: [(regex, PartitionSpec-like tuple)] matched against parameter
       names, first match wins; unmatched params are replicated.
     data_rules: [(regex, spec)] for feed vars; default shards dim 0 over "dp".
+    comm_compression: 'off'|'bf16'|'int8' -- compress the dp-axis gradient
+      allreduce (quantize -> psum -> dequantize with a per-tensor
+      error-feedback residual persistable; see paddle_tpu/comm/).  world 1
+      and tensors under ``comm_compress_min_bytes`` short-circuit to the
+      uncompressed path; per-tensor on/off above the floor is the
+      ``comm.compress`` TunableChoice.
     """
 
     def __init__(self, mesh_shape: Optional[Dict[str, int]] = None,
                  param_rules: Optional[List[Tuple[str, Tuple]]] = None,
                  data_rules: Optional[List[Tuple[str, Tuple]]] = None,
-                 data_axis: str = "dp"):
+                 data_axis: str = "dp",
+                 comm_compression: str = "off"):
         self.mesh_shape = dict(mesh_shape or {})
         self.param_rules = list(param_rules or [])
         self.data_rules = list(data_rules or [])
         self.data_axis = data_axis
+        self.comm_compression = comm_compression
+        # hard floor in bytes below which a tensor never compresses (the
+        # quantize arithmetic costs more than a small message saves)
+        from .comm.compress import MIN_COMPRESS_BYTES
+        self.comm_compress_min_bytes = MIN_COMPRESS_BYTES
         # multi-host/hierarchical knobs (parity with reference fleet strategy)
         self.use_hierarchical_allreduce = False
         self.nccl_comm_num = 1  # no-op: ICI has no rings to tune
 
     def __setattr__(self, name, value):
+        if name == "comm_compression":
+            from .comm.compress import MODES
+            if value not in MODES:
+                raise ValueError(
+                    f"comm_compression must be one of {MODES}, "
+                    f"got {value!r}")
         if name == "use_hierarchical_allreduce" and value:
             _warn_noop_knob(
                 "DistributedStrategy.use_hierarchical_allreduce",
@@ -144,7 +162,9 @@ class DistributedStrategy:
         return {"mesh_shape": dict(self.mesh_shape),
                 "param_rules": [[p, list(s)] for p, s in self.param_rules],
                 "data_rules": [[p, list(s)] for p, s in self.data_rules],
-                "data_axis": self.data_axis}
+                "data_axis": self.data_axis,
+                "comm_compression": self.comm_compression,
+                "comm_compress_min_bytes": self.comm_compress_min_bytes}
 
     @staticmethod
     def from_dict(d: dict) -> "DistributedStrategy":
@@ -156,11 +176,15 @@ class DistributedStrategy:
             return tuple(tuple(e) if isinstance(e, list) else e
                          for e in entries)
 
-        return DistributedStrategy(
+        ds = DistributedStrategy(
             mesh_shape=dict(d.get("mesh_shape") or {}),
             param_rules=[(p, spec(s)) for p, s in d.get("param_rules") or []],
             data_rules=[(p, spec(s)) for p, s in d.get("data_rules") or []],
-            data_axis=d.get("data_axis", "dp"))
+            data_axis=d.get("data_axis", "dp"),
+            comm_compression=d.get("comm_compression", "off"))
+        if "comm_compress_min_bytes" in d:
+            ds.comm_compress_min_bytes = int(d["comm_compress_min_bytes"])
+        return ds
 
     # -- mesh --------------------------------------------------------------------------
     def build_mesh(self, devices=None):
@@ -239,7 +263,9 @@ class CompiledProgram:
                 tuple((p, tuple(s)) for p, s in ds.param_rules),
                 tuple((p, tuple(s)) for p, s in ds.data_rules),
                 ds.data_axis, self.build_strategy.reduce_strategy,
-                getattr(self.build_strategy, "reduce_params", False))
+                getattr(self.build_strategy, "reduce_params", False),
+                getattr(ds, "comm_compression", "off"),
+                getattr(ds, "comm_compress_min_bytes", None))
 
     @property
     def mesh(self):
@@ -259,6 +285,15 @@ class CompiledProgram:
         from jax.sharding import NamedSharding, PartitionSpec as P
         from .framework import Parameter
         mesh = self.mesh
+        from .comm.compress import is_residual
+        if is_residual(name):
+            # error-feedback residual (comm/rewrite.py): per-DEVICE state of
+            # shape (ndp, *grad.shape), sharded over dp on its leading dim --
+            # one source of truth for compile and checkpoint stitching
+            v = self.program.global_block().find_var_recursive(name)
+            ndim = len(v.shape) if v is not None else 1
+            return NamedSharding(mesh, P(ds.data_axis,
+                                         *([None] * (ndim - 1))))
         v = self.program.global_block().find_var_recursive(name)
         spec = ds.param_spec(name) if v is not None else P()
         if v is not None and len(spec) > len(v.shape):

@@ -37,6 +37,7 @@ from ..observability.metrics import REGISTRY as _OBS
 # fault-injection hook points (resilience/faults.py); every call site is
 # guarded on `_rfaults._active` so the disarmed hot path costs one module
 # attribute read -- no env reads, no I/O
+from ..comm.compress import is_residual as _comm_is_residual
 from ..resilience import faults as _rfaults
 from . import registry
 from .registry import EMPTY_VAR, LowerCtx, stable_salt
@@ -603,7 +604,37 @@ class Executor:
                             f"feed {k!r} dim {dim} (={shape[dim]}) is not "
                             f"divisible by mesh axes {axes!r} ({n} "
                             f"shards); pad or drop the remainder batch")
+        if compiled_wrapper is not None and \
+                compiled_wrapper.dist_strategy is not None and (
+                    getattr(compiled_wrapper.dist_strategy,
+                            "comm_compression", "off") != "off"
+                    or getattr(program, "_comm_explicit", None) is not None):
+            # compressed gradient collectives (comm/rewrite.py): make the
+            # dp gradient reduction explicit so it can quantize.  Warm
+            # calls are a token compare -- zero mutation, zero recompile.
+            # Also entered when the knob was turned back OFF on an
+            # already-rewritten program: the sync then STRIPS the rewrite
+            # and the program reverts to the GSPMD path.
+            from .. import comm as _comm
+            _comm.sync_program(program, compiled_wrapper)
         state_in, state_out = self._state_names(program, feed, fetch_names)
+        if any(_comm_is_residual(n) for n in state_in):
+            # error-feedback residuals start at zero; they are created by
+            # the comm rewrite, not the startup program.  A stale scope
+            # entry whose shape no longer matches the program var (the
+            # world was resized in place) is re-zeroed too -- residual
+            # state is per-device and world-shaped.
+            gb = program.global_block()
+            for n in state_in:
+                if not _comm_is_residual(n):
+                    continue
+                v = gb.find_var_recursive(n)
+                cur = scope.find_var(n) if scope.has_var(n) else None
+                if cur is None or \
+                        tuple(np.shape(cur)) != tuple(v.shape):
+                    scope.set_var(n, np.zeros(
+                        tuple(v.shape),
+                        dtype=jax.dtypes.canonicalize_dtype(v.dtype)))
         missing = [n for n in state_in if not scope.has_var(n) or
                    scope.find_var(n) is None]
         if missing:
@@ -1687,6 +1718,18 @@ class Executor:
             new_state = {n: env[n] for n in state_out if n in env}
             return fetches, new_state
 
+        if wrapper is not None and wrapper.dist_strategy is not None and \
+                getattr(program, "_comm_explicit", None):
+            # Explicit-dp path (comm compression on): the whole step runs
+            # inside shard_map over the dp axis -- each shard traces on its
+            # LOCAL batch, gradients cross dp through the program's explicit
+            # (compressed) c_allreduce_avg ops instead of GSPMD's implicit
+            # f32 reduction.  Replication of the state outputs holds by
+            # construction (every shard-divergent path passes through a
+            # collective) and is pinned by the parity tests.
+            return self._compile_explicit_dp(
+                program, feed_names, fetch_names, mut_names, ro_names,
+                state_out, wrapper, seed)
         if wrapper is not None and wrapper.dist_strategy is not None:
             # SPMD path (the ParallelExecutor analog): jit over the strategy's mesh
             # with sharding constraints on state and feeds; XLA/GSPMD inserts the
@@ -1732,6 +1775,144 @@ class Executor:
             jit_kw["compiler_options"] = _xla_options()
         jitted = jax.jit(step, donate_argnums=(0,), **jit_kw)
         return _CompiledStep(jitted, (mut_names, ro_names), state_out, fetch_names)
+
+    def _compile_explicit_dp(self, program: Program, feed_names,
+                             fetch_names, mut_names, ro_names, state_out,
+                             wrapper, seed):
+        """Compile the step as ``jit(shard_map(step))`` over the dp axis
+        (comm compression -- see comm/rewrite.py).  Each shard traces the
+        SAME trace_block as the GSPMD path but on its local batch slice,
+        with the mesh bound (``LowerCtx.mesh``) so the program's explicit
+        collective ops -- including the inserted compressed gradient
+        allreduces -- lower to real ``lax`` collectives.  State is
+        replicated (in/out_specs P()) except the dp-sharded error-feedback
+        residuals; fetched floats are ``pmean``-ed across shards so a
+        fetched loss is the global-batch mean the GSPMD path returns."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+
+        block = program.global_block()
+        ds = wrapper.dist_strategy
+        mesh = wrapper.mesh
+        info = program._comm_explicit
+        dp = info["axis"]
+        var_of = block.find_var_recursive
+        from ..comm.compress import is_residual
+
+        def state_spec(n):
+            if is_residual(n):
+                v = var_of(n)
+                ndim = len(v.shape) if v is not None else 1
+                return P(dp, *([None] * (ndim - 1)))
+            return P()
+
+        def feed_spec(n):
+            v = var_of(n)
+            return ds.data_spec(n, len(v.shape) if v is not None else 1)
+
+        mut_specs = {n: state_spec(n) for n in mut_names}
+        ro_specs = {n: state_spec(n) for n in ro_names}
+        feed_specs = {n: feed_spec(n) for n in feed_names}
+        out_state_specs = {n: state_spec(n) for n in state_out}
+
+        ndp = int(info["ndp"])
+
+        def step(mut_state, ro_state, feed, rng_counter):
+            # per-shard stream: without the axis_index fold every shard
+            # would draw IDENTICAL random bits (correlated dropout masks
+            # across data-parallel shards).  Stochastic programs are
+            # therefore statistically equivalent to -- not bit-equal
+            # with -- the GSPMD trace; deterministic programs are pinned
+            # byte-identical.
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), rng_counter),
+                jax.lax.axis_index(dp))
+            env: Dict[str, Any] = {}
+            env.update(mut_state)
+            env.update(ro_state)
+            env.update(feed)
+
+            def block_runner(idx, sub_env, key=rng):
+                sub_block = program.blocks[idx]
+                merged = dict(env)
+                merged.update(sub_env)
+                return trace_block(sub_block, merged, key, block_runner,
+                                   mesh=mesh)
+
+            trace_block(block, env, rng, block_runner, mesh=mesh)
+            fetches = []
+            for n in fetch_names:
+                if n not in env:
+                    raise KeyError(
+                        f"fetch variable {n!r} was not produced by the "
+                        f"program and is not in the feed/scope")
+                f = env[n]
+                v = var_of(n)
+                d0 = v.shape[0] if v is not None and v.ndim else None
+                local0 = f.shape[0] if getattr(f, "ndim", 0) else None
+                if local0 is not None and (
+                        d0 == -1 or (isinstance(d0, int) and d0 > 0
+                                     and local0 * ndp == d0)):
+                    # batch-carrying fetch: declared dim 0 is dynamic, or
+                    # the traced local extent is exactly 1/ndp of the
+                    # declared global one.  Each shard holds its
+                    # contiguous block of rows -- all_gather reassembles
+                    # the full global batch the GSPMD fetch returns
+                    f = jax.lax.all_gather(f, dp, axis=0, tiled=True)
+                elif jnp.issubdtype(jnp.asarray(f).dtype, jnp.inexact):
+                    # per-shard means -> global-batch mean (matches the
+                    # GSPMD fetch of a loss/metric); non-float fetches
+                    # must already be replicated
+                    f = jax.lax.pmean(f, dp)
+                fetches.append(f)
+            new_state = {n: env[n] for n in state_out if n in env}
+            return fetches, new_state
+
+        # Replication is guaranteed by construction (every shard-divergent
+        # path -- the gradients -- passes through the inserted collectives;
+        # state updates are then deterministic functions of replicated
+        # values), but jax's static replication checker cannot infer it
+        # through the full op library (primitives without a rule are
+        # pessimistically 'varying'), so the check is disabled.  The
+        # convergence-parity tests pin the actual replication: explicit-mode
+        # losses match the GSPMD path.
+        from ..comm.compress import shard_map_nocheck_kwargs
+        check_kw = shard_map_nocheck_kwargs(shard_map)
+        local = shard_map(
+            step, mesh=mesh,
+            in_specs=(mut_specs, ro_specs, feed_specs, P()),
+            out_specs=([P()] * len(fetch_names), out_state_specs),
+            **check_kw)
+
+        def sharding(spec):
+            return NamedSharding(mesh, spec)
+
+        in_shardings = (
+            {n: sharding(s) for n, s in mut_specs.items()},
+            {n: sharding(s) for n, s in ro_specs.items()},
+            {n: sharding(s) for n, s in feed_specs.items()},
+            sharding(P()),
+        )
+        out_shardings = (
+            [sharding(P())] * len(fetch_names),
+            {n: sharding(s) for n, s in out_state_specs.items()},
+        )
+        jit_kw = {}
+        if _xla_options():
+            jit_kw["compiler_options"] = _xla_options()
+        jitted = jax.jit(local, donate_argnums=(0,),
+                         in_shardings=in_shardings,
+                         out_shardings=out_shardings, **jit_kw)
+        state_sh = dict(in_shardings[0])
+        state_sh.update(in_shardings[1])
+        return _CompiledStep(jitted, (mut_names, ro_names), state_out,
+                             fetch_names, state_shardings=state_sh,
+                             feed_shardings=in_shardings[2])
 
     def _compile_fused(self, program: Program, feed_names, fetch_names,
                        state_in, state_out, k: int, health_on: bool,

@@ -471,7 +471,15 @@ def _is_param(v):
 
 
 def _is_persistable(v):
-    return v.persistable and not v.is_data
+    if not v.persistable or v.is_data:
+        return False
+    from .comm.compress import is_residual
+    # comm error-feedback residuals are per-DEVICE advisory state with a
+    # world-size-pinned shape ((ndp, *grad.shape)): excluded from saves --
+    # a fresh zero residual after restore (or an elastic resize) is
+    # harmless, a stale world-8 residual restored into a world-6 program
+    # is not.  The executor re-zero-initializes them on first use.
+    return not is_residual(v.name)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):

@@ -49,6 +49,8 @@ COLLECTIVE_OPS: Dict[str, dict] = {
                            "default_axis": "dp"},
     "temporal_pipeline": {"comm": "pipeline", "axis_attr": "axis",
                           "default_axis": "pp"},
+    "reshard": {"comm": "reshard", "axis_attr": "axis_name",
+                "default_axis": "dp"},
 }
 
 
@@ -95,7 +97,92 @@ def _lax():
     return lax
 
 
-_coll("c_allreduce_sum", lambda x, n: _lax().psum(x, n))
+def _record(kind: str, x, name: str, mode: str = "off"):
+    """Trace-time wire-byte accounting (once per compile, never per
+    step): per-device bytes by collective kind and on-wire dtype through
+    the observability registry.  Payload is the tensor as the op sees it
+    (for the gradient allreduce that IS the logical tensor)."""
+    try:
+        from ..comm import compress as _compress
+        from ..comm import cost as _cost
+        n = _compress.axis_size(name)
+        if n <= 1:
+            return n
+        raw = int(x.size) * _cost.dtype_wire_bytes(str(x.dtype))
+        raw_wire = _cost.wire_bytes(kind, raw, n)
+        if mode in ("bf16", "int8"):
+            wire = _cost.wire_bytes(
+                kind, _cost.compressed_bytes(raw, str(x.dtype), mode, n), n)
+            dtype = mode if mode == "int8" else "bfloat16"
+        else:
+            wire, dtype = raw_wire, str(x.dtype)
+        _compress.record_collective(kind, dtype, raw_wire, wire)
+        return n
+    except Exception:
+        return 0   # telemetry must never fail a trace
+
+
+def _allreduce_compressed(ctx, ins, name, mean):
+    """The quantize -> psum -> dequantize path of c_allreduce_sum/avg
+    (DistributedStrategy.comm_compression via the comm.rewrite attr, or a
+    hand-set ``comm_compress`` attr -- the bench sweep door), with the
+    error-feedback residual threaded through the ResidualIn/ResidualOut
+    slots when the rewrite materialized one.  The residual persistable is
+    dp-sharded (ndp, *shape); its local block carries a leading 1-dim."""
+    from ..comm import compress as _compress
+    x = ins["X"][0]
+    mode = ctx.attr("comm_compress", "off")
+    res_in = (ins.get("ResidualIn") or [None])[0]
+    # resolve the EFFECTIVE mode before recording: an unsupported dtype
+    # ships full-width, and the telemetry must say so (PT048 surfaces it)
+    if mode in ("bf16", "int8") \
+            and str(x.dtype) not in _compress.SUPPORTED_DTYPES:
+        mode = "off"
+    n = _record("allreduce", x, name, mode)
+    if mode not in ("bf16", "int8") or n <= 1:
+        # unsupported dtype / unbound axis: the silent fallback PT048
+        # makes visible at lint time
+        import jax
+        out = (jax.lax.pmean if mean else jax.lax.psum)(x, name)
+        outs = {"Out": [out]}
+        if res_in is not None:
+            outs["ResidualOut"] = [res_in]
+        return outs
+    res_local = None
+    if res_in is not None:
+        import jax.numpy as jnp
+        res_local = jnp.squeeze(res_in, axis=0)
+    out, err = _compress.compressed_allreduce(
+        x, name, mode, residual=res_local, mean=mean, world=n)
+    outs = {"Out": [out]}
+    if res_in is not None:
+        import jax.numpy as jnp
+        outs["ResidualOut"] = [jnp.expand_dims(err, 0)]
+    return outs
+
+
+def _coll_allreduce(op_type, mean):
+    @register(op_type, grad="auto")
+    def lower(ctx, ins, mean=mean):
+        import jax
+        x = ins["X"][0]
+        name = _axis(ctx)
+        if ctx.mesh is None and not _axis_bound(name):
+            outs = {"Out": [x]}
+            res_in = (ins.get("ResidualIn") or [None])[0]
+            if res_in is not None:
+                outs["ResidualOut"] = [res_in]
+            return outs
+        if ctx.attr("comm_compress", "off") != "off" \
+                or "ResidualIn" in ins:
+            return _allreduce_compressed(ctx, ins, name, mean)
+        _record("allreduce", x, name)
+        return {"Out": [(jax.lax.pmean if mean else jax.lax.psum)(x, name)]}
+    return lower
+
+
+_coll_allreduce("c_allreduce_sum", mean=False)
+_coll_allreduce("c_allreduce_avg", mean=True)
 _coll("c_allreduce_max", lambda x, n: _lax().pmax(x, n))
 _coll("c_allreduce_min", lambda x, n: _lax().pmin(x, n))
 def _pprod(x, name):
@@ -108,7 +195,6 @@ def _pprod(x, name):
 
 
 _coll("c_allreduce_prod", _pprod)
-_coll("c_allreduce_avg", lambda x, n: _lax().pmean(x, n))
 
 
 @register("c_allgather")
@@ -118,6 +204,7 @@ def c_allgather(ctx, ins):
     name = _axis(ctx)
     if not _axis_bound(name):
         return {"Out": [x]}
+    _record("allgather", x, name)
     return {"Out": [jax.lax.all_gather(x, name, tiled=True)]}
 
 
@@ -128,6 +215,7 @@ def c_reducescatter(ctx, ins):
     name = _axis(ctx)
     if not _axis_bound(name):
         return {"Out": [x]}
+    _record("reducescatter", x, name)
     return {"Out": [jax.lax.psum_scatter(x, name, tiled=True)]}
 
 
@@ -141,6 +229,7 @@ def c_broadcast(ctx, ins):
     name = _axis(ctx)
     if not _axis_bound(name):
         return {"Out": [x]}
+    _record("broadcast", x, name)
     root = ctx.attr("root", 0)
     idx = jax.lax.axis_index(name)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
@@ -155,6 +244,7 @@ def alltoall(ctx, ins):
     name = _axis(ctx)
     if not _axis_bound(name):
         return {"Out": [x]}
+    _record("alltoall", x, name)
     return {"Out": [jax.lax.all_to_all(x, name, ctx.attr("split_axis", 0),
                                        ctx.attr("concat_axis", 0), tiled=True)]}
 
@@ -167,11 +257,50 @@ def collective_permute(ctx, ins):
     name = _axis(ctx)
     if not _axis_bound(name):
         return {"Out": [x]}
+    _record("permute", x, name)
     # static axis size via psum-of-1 (jax.lax.axis_size was removed)
     n = jax.lax.psum(1, name)
     off = ctx.attr("offset", 1)
     perm = [(i, (i + off) % n) for i in range(n)]
     return {"Out": [jax.lax.ppermute(x, name, perm)]}
+
+
+@register("reshard")
+def reshard_op(ctx, ins):
+    """Spec-to-spec redistribution: apply the comm.reshard planner's
+    minimal collective sequence to the local block of a sharded value.
+    Attrs: ``src_dim``/``dst_dim`` (-1 = replicated), ``axis_name``.  The
+    SAME decomposition the PT046 lint prices and the elastic host-chunk
+    reshard executes -- here lowered onto live device values inside
+    shard_map (the ZeRO param re-gather door: src_dim=k, dst_dim=-1 is
+    the priced all-gather)."""
+    import numpy as np
+    from ..comm import reshard as _reshard
+    x = ins["X"][0]
+    name = _axis(ctx)
+    if not _axis_bound(name):
+        return {"Out": [x]}
+    from ..comm import compress as _compress
+    n = _compress.axis_size(name)
+    src_dim = int(ctx.attr("src_dim", -1))
+    dst_dim = int(ctx.attr("dst_dim", -1))
+    src = _reshard.ShardSpec(None if src_dim < 0 else src_dim, n, name)
+    dst = _reshard.ShardSpec(None if dst_dim < 0 else dst_dim, n, name)
+    gshape = list(np.shape(x))
+    if src.sharded:
+        gshape[src.dim] *= n   # x is the local block of the source spec
+    plan = _reshard.plan_transfer(gshape, str(x.dtype), src, dst, axis=name)
+    for s in plan.steps:
+        if s.wire_bytes:
+            try:
+                # the plan already priced this step from the GLOBAL shape;
+                # record it as-is (re-deriving from the local block would
+                # undercount by the world size)
+                _compress.record_collective(s.collective, str(x.dtype),
+                                            s.wire_bytes, s.wire_bytes)
+            except Exception:
+                pass   # telemetry must never fail a trace
+    return {"Out": [_reshard.apply_transfer(x, plan, axis_name=name)]}
 
 
 @register("c_sync_calc_stream", grad="auto")

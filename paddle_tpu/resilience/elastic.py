@@ -189,6 +189,13 @@ class VarPlan:
     - ``redistribute``: sharded -> sharded with different boundaries
       (gather+slice per destination region)
 
+    The action (and the portable collective sequence ``collectives``,
+    e.g. ``["all_gather", "dynamic_slice"]`` for a boundary-incompatible
+    8->6) comes from the SHARED spec-to-spec decomposition
+    ``paddle_tpu.comm.plan_transfer`` -- the same planner the PT046 lint
+    prices and the ``reshard`` op lowers, so a planner regression that
+    adds redundant steps fails the pinned step-count tests here too.
+
     ``steps`` holds one entry per destination region:
     ``{"rank", "region", "reads": [{"file", "src", "dst"}, ...]}`` where
     ``src``/``dst`` are [[start, stop], ...] windows in chunk-local and
@@ -204,6 +211,7 @@ class VarPlan:
     bytes_out: int
     fallback: bool
     steps: List[dict]
+    collectives: List[str] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -307,16 +315,16 @@ def plan_reshard(metas: Dict[str, dict], target: Dict[str, dict],
         shape = list(m["shape"])
         src_keys = {tuple(map(tuple, ch["index"])) for ch in m["chunks"]}
         dst_keys = {tuple(map(tuple, r)) for _, r in tgt["regions"]}
-        src_sharded = len(src_keys) > 1
-        dst_sharded = len(dst_keys) > 1
-        if dst_keys == src_keys:
-            action = "keep"
-        elif src_sharded and not dst_sharded:
-            action = "gather"
-        elif not src_sharded and dst_sharded:
-            action = "slice"
-        else:
-            action = "redistribute"
+        # classify through the shared spec-to-spec decomposition
+        # (comm.plan_transfer): regions sorted canonically -- host chunk
+        # files have no rank identity, so a pure rank permutation is keep
+        from ..comm.reshard import plan_transfer as _plan_transfer
+        tplan = _plan_transfer(
+            shape, m["dtype"],
+            sorted([list(map(list, k)) for k in src_keys]),
+            sorted([list(map(list, k)) for k in dst_keys]))
+        action = {"keep": "keep", "slice": "slice", "gather": "gather",
+                  "permute": "keep"}.get(tplan.kind, "redistribute")
         isz = _dtype_bytes(m["dtype"])
         steps, bytes_read, bytes_out = [], 0, 0
         for rank, region in tgt["regions"]:
@@ -336,7 +344,8 @@ def plan_reshard(metas: Dict[str, dict], target: Dict[str, dict],
             name=name, action=action, shape=shape, dtype=m["dtype"],
             src_regions=len(src_keys), dst_regions=len(dst_keys),
             bytes_read=bytes_read, bytes_out=bytes_out,
-            fallback=bool(tgt.get("fallback")), steps=steps))
+            fallback=bool(tgt.get("fallback")), steps=steps,
+            collectives=list(tplan.collectives)))
     plan = ReshardPlan(src_world=src_world, dst_world=dst_world, vars=vars_)
     if journal:
         from ..observability import journal as _journal
@@ -348,6 +357,7 @@ def plan_reshard(metas: Dict[str, dict], target: Dict[str, dict],
                        "vars": [{"name": v.name, "action": v.action,
                                  "src_regions": v.src_regions,
                                  "dst_regions": v.dst_regions,
+                                 "collectives": v.collectives,
                                  "fallback": v.fallback}
                                 for v in vars_]})
     return plan

@@ -463,8 +463,46 @@ class FuseSteps(TunableChoice):
         return int(raw)
 
 
+# --------------------------------------------------------------------------------------
+# choice point 6: per-tensor gradient-allreduce compression (comm layer)
+# --------------------------------------------------------------------------------------
+
+
+class CommCompress(TunableChoice):
+    id = "comm.compress"
+    doc = ("per-tensor on/off for the compressed dp gradient allreduce "
+           "(DistributedStrategy.comm_compression): 'on' quantizes this "
+           "tensor (bf16/int8 + error feedback), 'off' keeps it f32. "
+           "Tensors under the min_bytes floor have no 'on' candidate -- "
+           "compression there is pure overhead. Like fuse_steps.k the "
+           "payoff is workload-level (wire time vs quantize arithmetic "
+           "on the live step), not isolated-jit measurable: external "
+           "measurements persist via tuning.record_decision().")
+
+    def bucket(self, params):
+        return {"nbytes": pow2_bucket(int(params["nbytes"])),
+                "world": int(params["world"]),
+                "mode": str(params["mode"])}
+
+    def candidates(self, params):
+        floor = int(params.get("min_bytes", 0))
+        if int(params["nbytes"]) < floor or int(params["world"]) <= 1:
+            return ["off"]
+        return ["off", "on"]
+
+    def default(self, params):
+        # the documented heuristic: compress everything the hard gates
+        # allow -- the knob was set deliberately, small tensors are
+        # already excluded by the floor
+        return "on" if "on" in self.candidates(params) else "off"
+
+    def bench(self, params, candidate):
+        return None   # measured on the live workload, never isolated
+
+
 register_choice(ConvBnBackend())
 register_choice(FlashBackend())
 register_choice(FlashBlockSizes())
 register_choice(ConvLayout())
 register_choice(FuseSteps())
+register_choice(CommCompress())

@@ -359,6 +359,104 @@ def test_pt047_needs_explicit_mesh_and_sharded_batch():
     assert "PT047" not in got, got
 
 
+def test_pt046_regather_message_carries_priced_plan():
+    """ISSUE 15: the PT046 finding names the concrete collective plan
+    (the shared comm.plan_transfer decomposition) with priced per-device
+    wire bytes -- and prices the compressed variant when the strategy
+    sets comm_compression."""
+    main, loss = _reduce_strategy_program()
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    bs.reduce_params = True
+    ds = fluid.DistributedStrategy(mesh_shape={"dp": 8})
+    cp = fluid.CompiledProgram(main, build_strategy=bs).with_strategy(ds)
+    diags = analysis.verify(main, feed_names=["x"],
+                            fetch_names=[loss.name], strategy=cp)
+    d = next(d for d in diags if d.code == "PT046")
+    assert "plan per param per step" in d.message
+    assert "all_gather" in d.message and "B/device" in d.message
+    # fc_0.w_0 is 16x8 f32 = 512 B; all_gather at dp=8 = (7/8)*512 = 448
+    assert "448" in d.message, d.message
+    # with compression set, the compressed pricing rides along
+    ds.comm_compression = "bf16"
+    cp2 = fluid.CompiledProgram(main, build_strategy=bs).with_strategy(ds)
+    diags2 = analysis.verify(main, feed_names=["x"],
+                             fetch_names=[loss.name], strategy=cp2)
+    d2 = next(d for d in diags2 if d.code == "PT046")
+    assert "compressed (bf16)" in d2.message
+
+
+# ------------------------------------------------------------ PT048 pins --
+
+def test_pt048_int8_unsupported_grad_dtype_warns():
+    """comm_compression=int8 + a gradient dtype outside the quantizer's
+    support: the lowering silently stays uncompressed -- PT048 makes it
+    visible at lint time."""
+    p = Program()
+    b = p.global_block()
+    b.create_var("w", (64, 64), "float64", persistable=True)
+    b.create_var("w@GRAD", (64, 64), "float64")
+    b.create_var("lr", (1,), "float32", persistable=True)
+    b.append_op("matmul", inputs={"X": ["w"], "Y": ["w"]},
+                outputs={"Out": ["w@GRAD"]}, infer_shape=False)
+    b.append_op("sgd", inputs={"Param": ["w"], "Grad": ["w@GRAD"],
+                               "LearningRate": ["lr"]},
+                outputs={"ParamOut": ["w"]}, infer_shape=False)
+    ds = fluid.DistributedStrategy(mesh_shape={"dp": 4})
+    ds.comm_compression = "int8"
+    diags = analysis.verify(p, strategy=ds)
+    d = next(d for d in diags if d.code == "PT048")
+    assert d.severity == "warn" and d.var == "w@GRAD"
+    assert "float64" in d.message and "uncompressed" in d.message
+    # supported dtype: no warning
+    p2 = Program()
+    b2 = p2.global_block()
+    b2.create_var("w", (64, 64), "float32", persistable=True)
+    b2.create_var("w@GRAD", (64, 64), "float32")
+    b2.create_var("lr", (1,), "float32", persistable=True)
+    b2.append_op("matmul", inputs={"X": ["w"], "Y": ["w"]},
+                 outputs={"Out": ["w@GRAD"]}, infer_shape=False)
+    b2.append_op("sgd", inputs={"Param": ["w"], "Grad": ["w@GRAD"],
+                                "LearningRate": ["lr"]},
+                 outputs={"ParamOut": ["w"]}, infer_shape=False)
+    assert "PT048" not in codes(analysis.verify(p2, strategy=ds))
+    # mode off/bf16: int8-specific check never fires
+    ds2 = fluid.DistributedStrategy(mesh_shape={"dp": 4})
+    assert "PT048" not in codes(analysis.verify(p, strategy=ds2))
+
+
+def test_pt048_explicit_allreduce_input_dtype():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (8, 4), "int64", is_data=True)
+    b.append_op("c_allreduce_sum", inputs={"X": ["x"]},
+                outputs={"Out": ["y"]}, infer_shape=False)
+    ds = fluid.DistributedStrategy(mesh_shape={"dp": 4})
+    ds.comm_compression = "int8"
+    diags = analysis.verify(p, strategy=ds)
+    assert any(d.code == "PT048" and d.var == "x" for d in diags)
+
+
+def test_memplan_accounts_comm_residual_overhead():
+    """The static planner adds the error-feedback residual bytes
+    comm_compression will materialize (1/ndp per device) -- before the
+    rewrite runs, so a budget check prices the real footprint."""
+    main, loss = _reduce_strategy_program()
+    ds = fluid.DistributedStrategy(mesh_shape={"dp": 8})
+    base = analysis.estimate_program_memory(
+        main, feed_names=["x"], fetch_names=[loss.name],
+        strategy=ds, batch=8)
+    ds2 = fluid.DistributedStrategy(mesh_shape={"dp": 8})
+    ds2.comm_compression = "int8"
+    ds2.comm_compress_min_bytes = 0
+    est = analysis.estimate_program_memory(
+        main, feed_names=["x"], fetch_names=[loss.name],
+        strategy=ds2, batch=8)
+    # fc grads: 16x8 w + 8 b = 136 floats = 544 B of residual per device
+    assert est.arg_bytes == base.arg_bytes + 544, \
+        (est.arg_bytes, base.arg_bytes)
+
+
 def test_pt046_unshardable_state_warn():
     """Reduce mode with an accumulator no dim of which divides dp: the
     ZeRO memory win silently doesn't happen -- warn."""

@@ -147,6 +147,45 @@ def test_plan_actions_classification():
     assert all(v.action == "keep" for v in p3.vars)
 
 
+def test_plan_collective_sequences_pinned():
+    """ISSUE 15: each VarPlan's action + collective sequence comes from
+    the SHARED comm.plan_transfer decomposition.  The step counts are
+    pinned so a planner regression that adds redundant collectives fails
+    loudly: redistribute (8->6) is exactly [all_gather, dynamic_slice],
+    gather is ONE all_gather, slice is ONE local dynamic_slice, keep is
+    empty."""
+    state = _mlp_state()
+    shard = lambda n: n != "learning_rate_0"  # noqa: E731
+    shapes = {n: list(v.shape) for n, v in state.items()}
+    metas8, _ = _chunked(state, 8, shard)
+    lay6 = elastic.zero_layout(shapes, 6, shard_vars=shard, warn=False)
+    p86 = elastic.plan_reshard(metas8, lay6, src_world=8, dst_world=6,
+                               journal=False)
+    by = {v.name: v for v in p86.vars}
+    for n in ("fc_0.w_0", "fc_0.b_0", "fc_0.w_0_moment", "fc_0.b_0_moment"):
+        assert by[n].collectives == ["all_gather", "dynamic_slice"], \
+            (n, by[n].collectives)
+    assert by["learning_rate_0"].collectives == []
+    metas1, _ = _chunked(state, 1)
+    p14 = elastic.plan_reshard(
+        metas1, elastic.zero_layout(shapes, 4, warn=False), journal=False)
+    assert {v.name: v.collectives for v in p14.vars if shard(v.name)} == {
+        n: ["dynamic_slice"] for n in shapes if shard(n)}
+    metas4, _ = _chunked(state, 4, shard)
+    p41 = elastic.plan_reshard(
+        metas4, elastic.zero_layout(shapes, 1, warn=False), journal=False)
+    assert all(v.collectives == ["all_gather"]
+               for v in p41.vars if shard(v.name)), \
+        {v.name: v.collectives for v in p41.vars}
+    # the journal carries the sequence per var
+    t0 = time.time()
+    elastic.plan_reshard(metas8, lay6, src_world=8, dst_world=6)
+    ev = [e for e in journal.recent(event="reshard_plan")
+          if e.get("ts", 0) >= t0][-1]
+    w = next(v for v in ev["vars"] if v["name"] == "fc_0.w_0")
+    assert w["collectives"] == ["all_gather", "dynamic_slice"]
+
+
 def test_plan_journals_per_var_events():
     state = _mlp_state()
     metas8, _ = _chunked(state, 8, lambda n: n != "learning_rate_0")

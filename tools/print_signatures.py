@@ -32,6 +32,7 @@ MODULES = [
     "paddle_tpu.contrib.mixed_precision",
     "paddle_tpu.contrib.quantize",
     "paddle_tpu.analysis",
+    "paddle_tpu.comm",
     "paddle_tpu.tuning",
     "paddle_tpu.resilience",
     "paddle_tpu.data",
