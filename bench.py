@@ -585,6 +585,13 @@ def _parse_args(argv=None):
     ap.add_argument("--comm-sweep-sizes", default=None,
                     help="comma-separated MB sizes for --comm-sweep "
                          "(default 1,4,16,64,256)")
+    ap.add_argument("--emit-hlo", metavar="DIR", default=None,
+                    help="capture every compiled program's optimized HLO + "
+                         "IR->HLO cost attribution as hlo_<label>.json "
+                         "artifacts under DIR (next to the --emit-metrics "
+                         "dump); diff two artifacts with python -m "
+                         "tools.hlo_diff A B. Degrades with a warning on "
+                         "backends without as_text()")
     ap.add_argument("--emit-trace", metavar="PATH", default=None,
                     help="after the run, export the flight-recorder timeline "
                          "(executor feed-prep/dispatch/fetch phase spans, "
@@ -624,6 +631,12 @@ if __name__ == "__main__":
         from paddle_tpu import profiler as _prof
         _flagsmod.set_flag("profile_executor", True)
         _prof.start_profiler()
+    if _args.emit_hlo:
+        # arm the attribution capture before any compile happens: every
+        # compile miss from here on writes an hlo_<label>.json artifact
+        # (HLO text + per-IR-op cost attribution) into the directory
+        from paddle_tpu.observability import attribution as _obs_attrib
+        _obs_attrib.arm_capture(_args.emit_hlo)
     if _args.tune:
         from paddle_tpu import tuning as _tuning
         _entries = _tuning.tune_suite("all", mode="search")
@@ -656,6 +669,14 @@ if __name__ == "__main__":
         _obs_export.dump_json(_args.emit_metrics)
         print(f"[bench] metrics registry written to {_args.emit_metrics}",
               file=sys.stderr)
+    if _args.emit_hlo:
+        from paddle_tpu.observability import attribution as _obs_attrib
+        _n_hlo = len([f for f in os.listdir(_args.emit_hlo)
+                      if f.startswith("hlo_")])
+        print(f"[bench] {_n_hlo} HLO attribution artifact(s) in "
+              f"{_args.emit_hlo} (diff: python -m tools.hlo_diff A B)",
+              file=sys.stderr)
+        _obs_attrib.arm_capture(None)
     if _args.emit_trace:
         from paddle_tpu.observability import timeline as _obs_timeline
         _obs_timeline.export_chrome_trace(_args.emit_trace)

@@ -39,6 +39,7 @@ from typing import List, Optional, Sequence
 from ..framework import Program
 from . import dataflow  # noqa: F401  (registers the pass)
 from . import distributed  # noqa: F401
+from . import layout_churn  # noqa: F401
 from . import memplan  # noqa: F401
 from . import recompile  # noqa: F401
 from . import typecheck  # noqa: F401

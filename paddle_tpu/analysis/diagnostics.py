@@ -119,6 +119,11 @@ CODES: Dict[str, tuple] = {
     "PT052": (Severity.WARN, "memory estimate resolved dynamic (-1) dims "
                              "with an assumed batch size; pass the real "
                              "batch for a trustworthy number"),
+    "PT060": (Severity.WARN, "an op pair forces a layout round-trip "
+                             "(copy/transpose churn) of significant bytes "
+                             "per step in the compiled program; consider "
+                             "the conv2d.layout autotune or reordering "
+                             "the producer"),
 }
 
 

@@ -1,0 +1,79 @@
+"""Layout-churn lint (PT060): blame compiled copy/transpose traffic on IR.
+
+The attribution walk (``observability.attribution``, run at compile miss
+when obs is armed) buckets every copy / transpose / bitcast-convert of
+the optimized HLO and blames its bytes on the (producer IR op, consumer
+IR op) pair on either side of the round trip.  This pass surfaces those
+pairs as PT060 warnings -- "op X forces a layout round-trip of N
+bytes/step; consider the ``conv2d.layout`` autotune" -- closing the loop
+the ROOFLINE copy-done finding left open.
+
+Registered opt-in (``default=False``) because it can only report on a
+program that has *already been compiled* with attribution armed
+(``PADDLE_TPU_OBS=1`` / ``PADDLE_TPU_OBS_ATTRIB=1`` / ``--emit-hlo``):
+``verify()`` normally runs pre-compile, where there is nothing to read.
+When named explicitly but no attribution exists, it emits nothing.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+from .diagnostics import Diagnostic
+from .pass_base import AnalysisPass, PassContext, register_pass
+
+#: a pair is worth warning about when its copy bytes clear both floors
+MIN_PAIR_BYTES = 4096
+MIN_PAIR_FRACTION = 0.01
+TOP_PAIRS = 5
+
+_IR_TOKEN = re.compile(r"^(.*)#(\d+)$")
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n:.0f} B"
+
+
+def _op_ref(token: str):
+    """'conv2d#12' -> ('conv2d', 12); 'input'/'output' -> (token, None)."""
+    m = _IR_TOKEN.match(token)
+    if m:
+        return m.group(1), int(m.group(2))
+    return token, None
+
+
+@register_pass(default=False)
+class LayoutChurnPass(AnalysisPass):
+    name = "layout_churn"
+
+    def run(self, ctx: PassContext) -> List[Diagnostic]:
+        from ..observability import attribution
+        attrib = attribution.lookup_program(ctx.program)
+        if attrib is None or not attrib.copy_pairs:
+            return []
+        floor = max(MIN_PAIR_BYTES,
+                    MIN_PAIR_FRACTION * attrib.total_bytes)
+        diags: List[Diagnostic] = []
+        for (producer, consumer), v in attrib.top_copy_pairs(TOP_PAIRS):
+            if v["bytes"] < floor:
+                continue
+            p_type, p_idx = _op_ref(producer)
+            c_type, c_idx = _op_ref(consumer)
+            # anchor the diagnostic on the consumer when it is a real op
+            # (it is the op whose operand layout forced the copy)
+            op_type, op_idx = (c_type, c_idx) if c_idx is not None \
+                else (p_type, p_idx)
+            diags.append(Diagnostic(
+                "PT060",
+                f"{producer} -> {consumer} forces a layout round-trip of "
+                f"{_fmt_bytes(v['bytes'])}/step "
+                f"({v['instructions']} copy/transpose instruction(s) in "
+                f"the compiled program, "
+                f"{v['bytes'] / attrib.total_bytes:.1%} of its modeled "
+                f"traffic); consider the conv2d.layout autotune or "
+                f"keeping the producer in the consumer's layout",
+                block_idx=0, op_idx=op_idx, op_type=op_type))
+        return diags

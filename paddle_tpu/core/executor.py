@@ -65,6 +65,10 @@ def _retire_program_gauges_if_dead(prog_id, version):
     label = f"{prog_id}:v{version}"
     for gname in _PROGRAM_GAUGES:
         _OBS.remove_labeled(gname, program=label)
+    # attribution gauges carry an extra category label, so exact-label
+    # removal can't reach them -- the owning module retires its own series
+    from ..observability import attribution as _obs_attrib
+    _obs_attrib.retire_program(label)
 
 
 def _cache_count(kind: str, cache: str, n: int = 1):
@@ -211,8 +215,10 @@ def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
     This is the single place op lowerings are invoked -- used by the jitted whole-program
     path, by control-flow sub-block lowering, and (eagerly) by the debug interpreter.
     """
+    import jax
+
     ops = block.ops if stop_at is None else block.ops[:stop_at]
-    for op in ops:
+    for op_idx, op in enumerate(ops):
         d = registry.get(op.type)
         ins: Dict[str, List[Any]] = {}
         for slot, names in op.inputs.items():
@@ -233,7 +239,13 @@ def trace_block(block: Block, env: Dict[str, Any], base_key, block_runner=None,
                        block_runner=block_runner, program=block.program, mesh=mesh,
                        gspmd_mesh=gspmd_mesh)
         try:
-            outs = d.lower(ctx, ins)
+            # IR->HLO attribution (observability/attribution.py): every HLO
+            # instruction this lowering traces carries "<op_type>#<op_idx>"
+            # in its op_name metadata, so the compiled module can be walked
+            # back to Program-IR ops. Trace-time only -- compiled steps
+            # replay the jaxpr and never re-enter this scope.
+            with jax.named_scope(f"{op.type}#{op_idx}"):
+                outs = d.lower(ctx, ins)
         except Exception as e:
             stack = op.creation_stack_str() if hasattr(
                 op, "creation_stack_str") else ""
@@ -511,6 +523,16 @@ class Executor:
             program, feed_shapes, feed_names, fetch_names,
             wrapper, label, xla_parts)
         _obs_memory.sample_device_memory("compile")
+        # IR->HLO attribution walk: once per compile miss, only when obs /
+        # PADDLE_TPU_OBS_ATTRIB / an armed --emit-hlo capture asks for it
+        # (on_compile is a no-op otherwise and never raises)
+        from ..observability import attribution as _obs_attrib
+        # megastep compiles attribute under their own label: a K=4 scan is
+        # a different executable than the K=1 step of the same program
+        # version, and hlo_diff-ing the two is the point
+        attrib_label = label if not getattr(compiled, "fused_k", None) \
+            else f"{label}:k{compiled.fused_k}"
+        _obs_attrib.on_compile(compiled, program, attrib_label)
 
     # -- public API --------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,

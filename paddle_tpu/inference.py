@@ -227,6 +227,13 @@ class Predictor:
                      for k in self.feed_names})
             exe = jax.jit(fwd).lower(*args).compile()   # AOT: no retrace
             self._compiled[sig] = exe
+            # IR->HLO attribution for the serving path: /metrics gains
+            # hlo_op_bytes{program="predict:<sig digest>",category=...}
+            # per compiled signature (no-op unless obs/attrib is armed)
+            from .observability import attribution as _obs_attrib
+            _obs_attrib.on_compile(
+                exe, self.program,
+                f"predict:{_obs_attrib.signature_digest(sig)}")
         return exe, True
 
     # -- serving -----------------------------------------------------------------------

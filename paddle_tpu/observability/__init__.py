@@ -23,6 +23,10 @@ reproduction gets the counterpart the whole-program-jit design enables:
   ``/metrics`` ``/healthz`` ``/goodput`` ``/journal``.
 - ``fleet``    -- cross-rank aggregation + straggler detection
   (``PADDLE_TPU_FLEET=gather|scrape``).
+- ``attribution`` -- IR->HLO cost attribution per compiled program
+  (``hlo_op_bytes{category}`` gauges, copy-pair blame feeding PT060,
+  ``--emit-hlo`` capture) and the ``hlo_diff`` regression explainer
+  (``python -m paddle_tpu.observability.attribution A B``).
 
 Render everything with ``python -m tools.obs_report``.
 """
@@ -53,3 +57,7 @@ from .server import (ObsServer,  # noqa: F401
                      start as start_server,
                      stop as stop_server)
 from .fleet import FleetMonitor, detect_stragglers  # noqa: F401
+from . import attribution  # noqa: F401
+from .attribution import (ProgramAttribution,  # noqa: F401
+                          attribute_hlo_text, diff_attributions,
+                          format_diff)

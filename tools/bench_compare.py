@@ -1,0 +1,352 @@
+"""Bench trajectory sentinel: machine memory of the repo's own bench rounds.
+
+    python -m tools.bench_compare BENCH_WORKLOADS_r0*.json   # summary
+    python -m tools.bench_compare --check [--baseline FILE] GLOBS...
+    python -m tools.bench_compare --update-baseline FILE GLOBS...
+    python -m tools.bench_compare --selftest   # hermetic; pinned by tests
+
+Parses any set of ``BENCH*_r<N>.json`` rounds (JSON-lines metric rows,
+single-dict dumps with a ``parsed`` row / embedded ``tail`` JSONL, or
+``rows``-list dumps) into per-metric trajectories and flags deltas beyond
+the noise threshold with direction-of-goodness awareness:
+
+- **cross-round**: consecutive rounds of one metric series, compared only
+  when both rounds ran on the same ``device_kind`` (a TPU round vs a
+  CPU-host round is a host change, not a regression);
+- **within-round**: ``vs_unfused_pct`` beyond the threshold in the bad
+  direction -- the fused-megastep A/B regressing against its own unfused
+  baseline in the same round (the r06 transformer finding).
+
+Known findings live in a JSONL baseline (one ``{"key": [...]}`` per
+line, ``--update-baseline`` to regenerate) so CI (``tools/ci_lint.py``)
+stays green on acknowledged data while any *new* regression fails the
+gate.  Exit 0 = clean/suppressed, 1 = unsuppressed regressions, 2 = usage.
+"""
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD_PCT = 10.0
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+_HIGHER = ("per_sec", "per_chip", "qps", "mfu", "saving", "availability",
+           "speedup", "fraction", "gain", "goodput", "throughput", "hit")
+_LOWER = ("_ms", "latency", "seconds", "_s", "p99", "p95", "bytes",
+          "lost", "stall", "skew", "overhead")
+
+
+def direction(metric: str) -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = unknown
+    (unknown metrics are tracked but never flagged)."""
+    name = metric.lower()
+    if any(t in name for t in _HIGHER):
+        return 1
+    if any(t in name for t in _LOWER):
+        return -1
+    return None
+
+
+def parse_round_file(path: str) -> List[dict]:
+    """One BENCH file -> metric rows ({metric, value, ...}); tolerant of
+    the three shapes that exist in the repo today."""
+    with open(path) as f:
+        text = f.read()
+    rows: List[dict] = []
+
+    def add(doc):
+        if isinstance(doc, dict) and "metric" in doc and "value" in doc:
+            rows.append(doc)
+
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if doc is None:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                add(json.loads(line))
+            except ValueError:
+                continue
+        return rows
+    if isinstance(doc, list):
+        for d in doc:
+            add(d)
+        return rows
+    if isinstance(doc, dict):
+        add(doc)
+        add(doc.get("parsed"))
+        for r in doc.get("rows", []) or []:
+            add(r)
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            for line in tail.splitlines():
+                line = line.strip()
+                if line.startswith("{") and '"metric"' in line:
+                    try:
+                        add(json.loads(line))
+                    except ValueError:
+                        continue
+    return rows
+
+
+def round_id(path: str) -> Tuple[str, int]:
+    """'BENCH_WORKLOADS_r06.json' -> ('BENCH_WORKLOADS', 6)."""
+    base = os.path.basename(path)
+    m = _ROUND_RE.search(base)
+    if not m:
+        return base.replace(".json", ""), 0
+    return base[:m.start()], int(m.group(1))
+
+
+def build_trajectories(paths: List[str]) -> Dict[Tuple[str, str, int],
+                                                 List[dict]]:
+    """(family, metric, occurrence idx) -> chronological round points.
+    The occurrence index keeps repeated metric names within one file
+    (e.g. per-batch-size latency rows) in separate series."""
+    series: Dict[Tuple[str, str, int], List[dict]] = {}
+    for path in sorted(paths, key=lambda p: (round_id(p)[0],
+                                             round_id(p)[1])):
+        family, rnd = round_id(path)
+        seen: Dict[str, int] = {}
+        for row in parse_round_file(path):
+            metric = str(row["metric"])
+            occ = seen.get(metric, 0)
+            seen[metric] = occ + 1
+            series.setdefault((family, metric, occ), []).append(
+                {"round": rnd, "value": row["value"],
+                 "device_kind": row.get("device_kind"),
+                 "vs_unfused_pct": row.get("vs_unfused_pct"),
+                 "unit": row.get("unit"), "file": os.path.basename(path)})
+    return series
+
+
+def find_regressions(series, threshold_pct: float = DEFAULT_THRESHOLD_PCT
+                     ) -> List[dict]:
+    """Flag bad-direction deltas beyond the threshold.  Each finding has
+    a stable ``key`` for baseline suppression."""
+    findings: List[dict] = []
+    for (family, metric, occ), points in sorted(series.items()):
+        dirn = direction(metric)
+        for a, b in zip(points, points[1:]):
+            if not (isinstance(a["value"], (int, float))
+                    and isinstance(b["value"], (int, float)) and a["value"]):
+                continue
+            if a["device_kind"] != b["device_kind"]:
+                continue  # host change, not a regression
+            pct = (b["value"] - a["value"]) / abs(a["value"]) * 100.0
+            if dirn is None or abs(pct) < threshold_pct:
+                continue
+            if pct * dirn < 0:
+                findings.append({
+                    "kind": "cross_round", "family": family,
+                    "metric": metric, "pct": round(pct, 1),
+                    "detail": f"{metric} {a['value']} (r{a['round']:02d})"
+                              f" -> {b['value']} (r{b['round']:02d})"
+                              f" on {b['device_kind']}: {pct:+.1f}%",
+                    "key": ["cross_round", family, metric, str(occ),
+                            f"r{a['round']:02d}->r{b['round']:02d}"]})
+        for p in points:
+            vu = p.get("vs_unfused_pct")
+            if not isinstance(vu, (int, float)):
+                continue
+            # vs_unfused_pct is % vs the unfused twin of a higher-better
+            # rate metric; negative beyond threshold = fused regression
+            if vu <= -threshold_pct:
+                findings.append({
+                    "kind": "within_round", "family": family,
+                    "metric": metric, "pct": round(vu, 1),
+                    "detail": f"{metric} r{p['round']:02d} fused vs "
+                              f"unfused {vu:+.1f}% on "
+                              f"{p['device_kind']} (same round A/B)",
+                    "key": ["within_round", family, metric,
+                            f"r{p['round']:02d}"]})
+    return findings
+
+
+def load_baseline(path: str) -> List[List[str]]:
+    keys = []
+    if path and os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    keys.append([str(k) for k in
+                                 json.loads(line)["key"]])
+    return keys
+
+
+def write_baseline(path: str, findings: List[dict]) -> None:
+    with open(path, "w") as f:
+        for fd in findings:
+            f.write(json.dumps({"key": fd["key"],
+                                "detail": fd["detail"]}) + "\n")
+
+
+def suppress(findings: List[dict], baseline_keys: List[List[str]]
+             ) -> Tuple[List[dict], int]:
+    fresh = [f for f in findings if f["key"] not in baseline_keys]
+    return fresh, len(findings) - len(fresh)
+
+
+def render(series, findings, suppressed: int = 0,
+           max_series: int = 0) -> List[str]:
+    """Human summary -- also embedded by obs_report's 'Attribution &
+    trajectory' section."""
+    rounds = sorted({p["round"] for pts in series.values() for p in pts})
+    lines = [f"bench trajectory: {len(series)} metric series over "
+             f"{len(rounds)} round(s) "
+             f"({', '.join(f'r{r:02d}' for r in rounds)})"]
+    shown = sorted(series.items())
+    if max_series:
+        shown = shown[:max_series]
+    for (family, metric, occ), points in shown:
+        arrow = " -> ".join(
+            f"{p['value']}@r{p['round']:02d}" for p in points)
+        tag = f"[{occ}]" if occ else ""
+        lines.append(f"  {family}/{metric}{tag}: {arrow}")
+    if max_series and len(series) > max_series:
+        lines.append(f"  ... {len(series) - max_series} more series")
+    if findings:
+        lines.append(f"  {len(findings)} regression(s) beyond threshold:")
+        for f in findings:
+            lines.append(f"    REGRESSION {f['detail']}")
+    else:
+        lines.append("  no unsuppressed regressions")
+    if suppressed:
+        lines.append(f"  ({suppressed} known finding(s) suppressed by "
+                     f"baseline)")
+    return lines
+
+
+def compare_files(paths: List[str],
+                  threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+                  baseline: Optional[str] = None) -> dict:
+    """The whole pipeline as one call (used by obs_report and ci_lint)."""
+    series = build_trajectories(paths)
+    findings = find_regressions(series, threshold_pct)
+    fresh, suppressed = suppress(findings, load_baseline(baseline)
+                                 if baseline else [])
+    return {"series": series, "findings": findings, "fresh": fresh,
+            "suppressed": suppressed}
+
+
+def _expand(patterns: List[str]) -> List[str]:
+    paths: List[str] = []
+    for pat in patterns:
+        hits = sorted(globmod.glob(pat))
+        paths.extend(hits if hits else ([pat] if os.path.exists(pat)
+                                        else []))
+    return paths
+
+
+def selftest() -> int:
+    """Hermetic pin: synthetic three-round family with one cross-round
+    regression, one same-round fused regression, one host change that
+    must NOT flag, and baseline suppression round-trip."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        def w(name, rows):
+            p = os.path.join(td, name)
+            with open(p, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+            return p
+
+        paths = [
+            w("BENCH_X_r01.json", [
+                {"metric": "m_tokens_per_sec", "value": 1000.0,
+                 "device_kind": "tpu"},
+                {"metric": "m_latency_ms", "value": 10.0,
+                 "device_kind": "tpu"}]),
+            w("BENCH_X_r02.json", [
+                {"metric": "m_tokens_per_sec", "value": 800.0,
+                 "device_kind": "tpu"},          # -20% cross-round
+                {"metric": "m_latency_ms", "value": 10.5,
+                 "device_kind": "tpu"}]),        # +5% -- under threshold
+            w("BENCH_X_r03.json", [
+                {"metric": "m_tokens_per_sec", "value": 50.0,
+                 "device_kind": "cpu"},          # host change: no flag
+                {"metric": "m_tokens_per_sec_fused", "value": 30.0,
+                 "device_kind": "cpu", "vs_unfused_pct": -40.0}]),
+        ]
+        res = compare_files(paths)
+        kinds = sorted(f["kind"] for f in res["findings"])
+        assert kinds == ["cross_round", "within_round"], \
+            f"selftest: findings wrong: {res['findings']}"
+        cross = next(f for f in res["findings"]
+                     if f["kind"] == "cross_round")
+        assert cross["metric"] == "m_tokens_per_sec" and \
+            cross["pct"] == -20.0, f"selftest: cross wrong: {cross}"
+        within = next(f for f in res["findings"]
+                      if f["kind"] == "within_round")
+        assert within["pct"] == -40.0, f"selftest: within wrong: {within}"
+        bp = os.path.join(td, "baseline.jsonl")
+        write_baseline(bp, res["findings"])
+        res2 = compare_files(paths, baseline=bp)
+        assert not res2["fresh"] and res2["suppressed"] == 2, \
+            "selftest: baseline suppression failed"
+        text = "\n".join(render(res["series"], res["findings"]))
+        assert "REGRESSION" in text and "m_tokens_per_sec" in text
+        # unknown-direction metrics are tracked, never flagged
+        assert direction("m_mystery_count") is None
+    print("bench_compare selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.bench_compare",
+        description="compare checked-in BENCH*_r*.json rounds and flag "
+                    "regressions beyond the noise threshold")
+    ap.add_argument("paths", nargs="*",
+                    help="BENCH round files or globs")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD_PCT,
+                    help="noise threshold in percent (default 10)")
+    ap.add_argument("--baseline", default=None,
+                    help="JSONL of known-finding keys to suppress")
+    ap.add_argument("--update-baseline", metavar="FILE", default=None,
+                    help="write all current findings as the new baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when unsuppressed regressions exist "
+                         "(the CI smoke gate)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    paths = _expand(args.paths)
+    if not paths:
+        ap.error("no bench round files matched")
+    res = compare_files(paths, args.threshold, args.baseline)
+    if args.update_baseline:
+        write_baseline(args.update_baseline, res["findings"])
+        print(f"wrote {len(res['findings'])} finding key(s) to "
+              f"{args.update_baseline}")
+        return 0
+    if args.json:
+        out = {"findings": res["findings"], "fresh": res["fresh"],
+               "suppressed": res["suppressed"]}
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        print("\n".join(render(res["series"], res["fresh"],
+                               res["suppressed"])))
+    if args.check and res["fresh"]:
+        print(f"bench_compare: {len(res['fresh'])} unsuppressed "
+              f"regression(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,7 +16,13 @@ Two checks, one command, one exit code:
    and ``tools/``, honoring the pyproject per-file-ignores: ``__init__.py``
    facades are exempt, ``# noqa`` lines are skipped.
 
-    python tools/ci_lint.py                          # both checks
+3. **Bench trajectory**: ``tools/bench_compare.py --check`` over the
+   checked-in ``BENCH_WORKLOADS_r*.json`` rounds -- any regression beyond
+   the noise threshold that is not acknowledged in
+   ``tools/bench_baseline.jsonl`` fails the gate (the r06 fused-transformer
+   finding is acknowledged there; a *new* one is not).
+
+    python tools/ci_lint.py                          # all checks
     python tools/ci_lint.py --baseline ci_lint.keys  # gate on new findings
     python tools/ci_lint.py --selftest               # pinned by the tests
 
@@ -232,6 +238,26 @@ def lint_imports(roots=("paddle_tpu", "tools")) -> List[str]:
     return findings
 
 
+# -------------------------------------------------------- bench trajectory --
+
+BENCH_ROUND_GLOB = os.path.join(REPO, "BENCH_WORKLOADS_r*.json")
+BENCH_BASELINE = os.path.join(REPO, "tools", "bench_baseline.jsonl")
+
+
+def lint_bench() -> List[str]:
+    """Unsuppressed bench-trajectory regressions over the checked-in
+    WORKLOADS rounds (detail strings; empty = gate green)."""
+    import glob
+    from tools import bench_compare
+    paths = sorted(glob.glob(BENCH_ROUND_GLOB))
+    if not paths:
+        return []
+    res = bench_compare.compare_files(
+        paths, baseline=BENCH_BASELINE
+        if os.path.exists(BENCH_BASELINE) else None)
+    return [f["detail"] for f in res["fresh"]]
+
+
 # ----------------------------------------------------------------- driver --
 
 def _load_baseline(path: str) -> Dict[str, set]:
@@ -300,13 +326,33 @@ def selftest() -> int:
         keys = _load_baseline(bpath)
         if d.key() not in keys.get("progA", set()) or "progB" in keys:
             failures.append(f"baseline round trip broken: {keys}")
+    # 5. the bench sentinel: on today's checked-in rounds the detector
+    # must find the r06 fused-transformer regression (proof it works) and
+    # the shipped baseline must suppress everything (proof CI is green)
+    import glob
+    from tools import bench_compare
+    paths = sorted(glob.glob(BENCH_ROUND_GLOB))
+    if paths:
+        res = bench_compare.compare_files(paths)
+        hits = [f for f in res["findings"]
+                if f["kind"] == "within_round" and
+                "transformer" in f["metric"] and "fused" in f["metric"]]
+        if not hits:
+            failures.append("bench sentinel missed the r06 "
+                            "fused-transformer regression: "
+                            f"{res['findings']}")
+        fresh = lint_bench()
+        if fresh:
+            failures.append("bench baseline does not suppress current "
+                            "findings:\n  " + "\n  ".join(fresh))
     if failures:
         print("ci_lint selftest: FAILED")
         for msg in failures:
             print(" -", msg)
         return 1
     print(f"ci_lint selftest: OK ({len(EXAMPLE_PROGRAMS)} example programs "
-          f"x 3 variants verified, import sweep clean)")
+          f"x 3 variants verified, import sweep clean, bench sentinel "
+          f"armed)")
     return 0
 
 
@@ -325,6 +371,8 @@ def main(argv=None) -> int:
                     help="run only the program lint")
     ap.add_argument("--skip-programs", action="store_true",
                     help="run only the unused-import sweep")
+    ap.add_argument("--skip-bench", action="store_true",
+                    help="skip the bench trajectory check")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
@@ -369,6 +417,17 @@ def main(argv=None) -> int:
             rc = 1
         else:
             print("unused imports: clean")
+    if not args.skip_bench:
+        fresh = lint_bench()
+        for f in fresh:
+            print(f"bench: REGRESSION {f}")
+        if fresh:
+            print(f"bench trajectory: {len(fresh)} unsuppressed "
+                  f"regression(s) (acknowledge in "
+                  f"tools/bench_baseline.jsonl if real)")
+            rc = 1
+        else:
+            print("bench trajectory: clean")
     return rc
 
 

@@ -1,0 +1,20 @@
+"""Diff two captured HLO programs by IR-attributed cost category.
+
+    python -m tools.hlo_diff A B [--top N] [--json] [--summary]
+    python -m tools.hlo_diff --selftest     # hermetic; pinned by tests
+
+Comparands are ``bench.py --emit-hlo`` artifacts (``hlo_<label>.json``,
+HLO text + attribution) or raw ``as_text()`` dumps -- auto-detected.
+Reports per-category (fusion / layout / collective / dynamic-slice /
+compute / elementwise) instruction and byte deltas, with the top-k
+grown ops named by their Program-IR attribution
+(``<op_type>#<op_idx>`` from the executor's named_scope metadata).
+
+Thin front door over ``paddle_tpu.observability.attribution`` -- the
+module CLI (``python -m paddle_tpu.observability.attribution``) is the
+same tool.  Exit 0 = diffed, 2 = bad comparand / usage.
+"""
+from paddle_tpu.observability.attribution import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

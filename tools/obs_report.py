@@ -574,6 +574,57 @@ def render_memory(snapshot: dict) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------ attribution --
+
+def render_attribution(events: Optional[List[dict]],
+                       snapshot: Optional[dict],
+                       bench_summary: Optional[List[str]] = None) -> str:
+    """Inside the compiled program: per-category hlo_op_bytes gauges
+    (paddle_tpu/observability/attribution.py, set at compile miss when
+    obs/PADDLE_TPU_OBS_ATTRIB is armed), the journal's attribution events
+    with copy-pair blame, and -- when the caller passed --bench rounds --
+    the bench_compare trajectory summary."""
+    lines = ["== Attribution & trajectory =="]
+    progs = {}
+    fams = {}
+    for f in (snapshot or {}).get("families", []):
+        if f["name"] in ("hlo_op_bytes", "hlo_attributed_bytes_fraction"):
+            fams.setdefault(f["name"], []).extend(f.get("samples", []))
+    for s in fams.get("hlo_op_bytes", []):
+        lab = s.get("labels", {})
+        progs.setdefault(lab.get("program", "?"), {})[
+            lab.get("category", "?")] = s.get("value", 0.0)
+    cover = {s.get("labels", {}).get("program", "?"): s.get("value")
+             for s in fams.get("hlo_attributed_bytes_fraction", [])}
+    for label, cats in sorted(progs.items()):
+        total = sum(cats.values())
+        split = ", ".join(f"{c} {_gb(v)}" for c, v in
+                          sorted(cats.items(), key=lambda kv: -kv[1])
+                          if v)
+        line = f"  program {label}: {_gb(total)} modeled/step ({split})"
+        if cover.get(label) is not None:
+            line += f"; {cover[label]:.0%} IR-attributed"
+        lines.append(line)
+    attrib_events = [e for e in (events or [])
+                     if e.get("event") == "attribution"]
+    for e in attrib_events[-4:]:
+        tops = ", ".join(f"{t['ir']} {_gb(t['bytes'])}"
+                         for t in e.get("top_ops", [])[:3])
+        if tops:
+            lines.append(f"  {e.get('program', '?')} top ops: {tops}")
+        for p in e.get("copy_pairs", [])[:3]:
+            lines.append(f"    layout round-trip {p['producer']} -> "
+                         f"{p['consumer']}: {_gb(p['bytes'])} in "
+                         f"{p['n']} copy/transpose(s)  [PT060]")
+    if not progs and not attrib_events:
+        lines.append("(no attribution samples; compile with "
+                     "PADDLE_TPU_OBS_ATTRIB=1 or bench --emit-hlo)")
+    if bench_summary:
+        lines.append("  -- bench trajectory (tools/bench_compare.py) --")
+        lines.extend("  " + ln for ln in bench_summary)
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------- goodput --
 
 def render_goodput(events: Optional[List[dict]],
@@ -736,7 +787,8 @@ def load_metrics(path: str) -> dict:
 def render_report(events: Optional[List[dict]],
                   snapshot: Optional[dict],
                   trace_events: Optional[List[dict]] = None,
-                  goodput: bool = False, fleet: bool = False) -> str:
+                  goodput: bool = False, fleet: bool = False,
+                  bench_summary: Optional[List[str]] = None) -> str:
     parts = ["# paddle_tpu observability report"]
     if events is not None:
         parts.append(render_journal(events))
@@ -746,6 +798,8 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_checkpoint(events, snapshot))
         parts.append(render_serving(events, snapshot))
         parts.append(render_ingestion(events, snapshot))
+    if bench_summary is not None or snapshot is not None or events:
+        parts.append(render_attribution(events, snapshot, bench_summary))
     if goodput:
         parts.append(render_goodput(events, snapshot))
     if fleet:
@@ -789,6 +843,11 @@ def selftest() -> int:
     reg.gauge("program_temp_bytes", program="1:v0").set(3e8)
     reg.gauge("program_static_peak_bytes", program="1:v0").set(1.8e9)
     reg.gauge("program_static_peak_ratio", program="1:v0").set(1.2)
+    # attribution section sources (observability/attribution.py)
+    reg.gauge("hlo_op_bytes", program="1:v0", category="fusion").set(3e8)
+    reg.gauge("hlo_op_bytes", program="1:v0", category="layout").set(6.4e7)
+    reg.gauge("hlo_op_bytes", program="1:v0", category="compute").set(1e8)
+    reg.gauge("hlo_attributed_bytes_fraction", program="1:v0").set(0.978)
     reg.counter("fused_fetch_materializations_total").inc(3)
     reg.counter("tensor_nonfinite_total", where="executor").inc()
     reg.counter("anomaly_total", kind="step_time").inc()
@@ -837,6 +896,14 @@ def selftest() -> int:
          "feed": {"x": [[8, 3], "float32"]}, "fetch": ["loss"], "ts": 1.0},
         {"event": "recompile", "program": 1, "version": 0,
          "changed": ["shape"], "ts": 2.0},
+        # attribution section (IR->HLO cost attribution at compile miss)
+        {"event": "attribution", "program": "1:v0", "instructions": 740,
+         "model_bytes": 4.64e8, "cost_bytes": 4.6e8, "coverage": 0.978,
+         "categories": {"fusion": 3e8, "layout": 6.4e7, "compute": 1e8},
+         "top_ops": [{"ir": "conv2d#12", "bytes": 9e7},
+                     {"ir": "momentum#163", "bytes": 4e7}],
+         "copy_pairs": [{"producer": "input", "consumer": "momentum#163",
+                         "bytes": 1.9e7, "n": 1}], "ts": 2.1},
         # megastep section (fused multi-step execution)
         {"event": "megastep", "program": 1, "version": 0, "cache": "miss",
          "k": 8, "step0": 0, "compile_ms": 950.0, "run_ms": 24.0,
@@ -961,9 +1028,24 @@ def selftest() -> int:
                 obs_timeline._counters.clear()
                 obs_timeline._counters.extend(saved[1])
 
+        # a synthetic two-round bench family for the trajectory summary
+        from tools import bench_compare
+        for rnd, val in (("01", 1000.0), ("02", 700.0)):
+            with open(os.path.join(td, f"BENCH_SELF_r{rnd}.json"),
+                      "w") as f:
+                f.write(json.dumps({"metric": "m_tokens_per_sec",
+                                    "value": val,
+                                    "device_kind": "tpu"}) + "\n")
+        bres = bench_compare.compare_files(
+            sorted(os.path.join(td, f"BENCH_SELF_r{r}.json")
+                   for r in ("01", "02")))
+        bench_summary = bench_compare.render(bres["series"],
+                                             bres["findings"])
+
         from paddle_tpu.observability.journal import read_journal
         report = render_report(read_journal(jpath), load_metrics(mpath),
-                               load_trace(tpath), goodput=True, fleet=True)
+                               load_trace(tpath), goodput=True, fleet=True,
+                               bench_summary=bench_summary)
         for must in ("2 executor runs", "1 recompiles", "hit rate",
                      "changed ['shape']", "program_mfu", "0.42",
                      "executor_run_seconds", "n=4",
@@ -1031,6 +1113,17 @@ def selftest() -> int:
                      "== Fleet ==", "1 collection(s) [gather]",
                      "rank 1 (h1): step 13.0ms", "STRAGGLER rank 1",
                      "1 elastic restart(s), 1.2s measured downtime",
+                     # attribution & trajectory section (ISSUE 16)
+                     "== Attribution & trajectory ==",
+                     "program 1:v0: 464.000 MB modeled/step",
+                     "fusion 300.000 MB", "layout 64.000 MB",
+                     "98% IR-attributed",
+                     "1:v0 top ops: conv2d#12 90.000 MB",
+                     "layout round-trip input -> momentum#163: "
+                     "19.000 MB in 1 copy/transpose(s)  [PT060]",
+                     "bench trajectory: 1 metric series over 2 round(s)",
+                     "REGRESSION m_tokens_per_sec 1000.0 (r01) -> "
+                     "700.0 (r02) on tpu: -30.0%",
                      # memory section (incl. the static-planner comparison)
                      "cpu:0", "512.000 MB", "peak 1.500 GB",
                      "static plan 1.800 GB", "(1.20x of XLA)",
@@ -1050,6 +1143,8 @@ def selftest() -> int:
         assert "unfused" in render_megastep([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})
+        assert "no attribution samples" in \
+            render_attribution([], {"families": []})
         assert "no goodput window" in render_goodput([], None)
         assert "single-rank" in render_fleet([])
     print("obs_report selftest: OK")
@@ -1081,6 +1176,10 @@ def main(argv=None) -> int:
                     help="add the Fleet section: per-rank step times, "
                          "skew, straggler verdicts and elastic-restart "
                          "downtime from a merged multi-rank journal")
+    ap.add_argument("--bench", nargs="+", default=None, metavar="GLOB",
+                    help="BENCH*_r*.json round files/globs: embed the "
+                         "tools/bench_compare.py trajectory summary in "
+                         "the Attribution & trajectory section")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
@@ -1103,11 +1202,22 @@ def main(argv=None) -> int:
         snapshot = to_dict()
     if args.trace:
         trace_events = load_trace(args.trace)
-    if events is None and snapshot is None and trace_events is None:
+    bench_summary = None
+    if args.bench:
+        from tools import bench_compare
+        bpaths = bench_compare._expand(args.bench)
+        if bpaths:
+            res = bench_compare.compare_files(bpaths)
+            bench_summary = bench_compare.render(res["series"],
+                                                 res["findings"])
+    if events is None and snapshot is None and trace_events is None \
+            and bench_summary is None:
         ap.error("nothing to report: pass --journal, --metrics and/or "
-                 "--trace (or --live), or run with PADDLE_TPU_OBS=1 first")
+                 "--trace (or --live or --bench), or run with "
+                 "PADDLE_TPU_OBS=1 first")
     print(render_report(events, snapshot, trace_events,
-                        goodput=args.goodput, fleet=args.fleet))
+                        goodput=args.goodput, fleet=args.fleet,
+                        bench_summary=bench_summary))
     return 0
 
 
