@@ -665,6 +665,28 @@ if __name__ == "__main__":
             "lost_seconds": {c: round(s, 3)
                              for c, s in sorted(_gr.lost.items()) if s},
         }), flush=True)
+        # trajectory sentinel rides along too: scan the checked-in bench
+        # rounds so fresh regressions land in this dump as journal
+        # bench_regression events + bench_regressions_total counters
+        # (same alert/journal plane as the runtime; degrades silently)
+        try:
+            import glob as globmod
+            from tools import bench_compare as _bcmp
+            _rounds = sorted(globmod.glob(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_WORKLOADS_r*.json")))
+            if _rounds:
+                _cmp = _bcmp.compare_files(
+                    _rounds, baseline=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "bench_baseline.jsonl"))
+                if _cmp["fresh"]:
+                    print(f"[bench] trajectory sentinel: "
+                          f"{len(_cmp['fresh'])} fresh regression(s) "
+                          f"journaled", file=sys.stderr)
+        except Exception as _e:   # the sentinel must never fail a bench
+            print(f"[bench] trajectory sentinel skipped: {_e}",
+                  file=sys.stderr)
         from paddle_tpu.observability import export as _obs_export
         _obs_export.dump_json(_args.emit_metrics)
         print(f"[bench] metrics registry written to {_args.emit_metrics}",

@@ -307,8 +307,9 @@ class Executor:
         # hooks only see fully-constructed executors (a raised typo'd-env
         # ValueError must not leave a half-built instance in _instances
         # for _retire_program_gauges_if_dead to trip over).  With
-        # PADDLE_TPU_OBS_PORT / PADDLE_TPU_FLEET unset each hook is one
-        # env read -- no socket, no thread, no per-step work
+        # PADDLE_TPU_OBS_PORT / PADDLE_TPU_FLEET / PADDLE_TPU_OBS_SLO
+        # unset each hook is one env read -- no socket, no thread, no
+        # per-step work
         # (guard-tested); armed, only a typo'd mode may abort
         # construction.
         try:
@@ -324,6 +325,14 @@ class Executor:
         except Exception as e:
             import warnings
             warnings.warn(f"paddle_tpu fleet telemetry disabled: {e}")
+        try:
+            from ..observability import slo as _obs_slo
+            _obs_slo.maybe_arm()
+        except ValueError:
+            raise   # typo'd rules file: never silently drop the user's SLOs
+        except Exception as e:
+            import warnings
+            warnings.warn(f"paddle_tpu SLO engine disabled: {e}")
         Executor._instances.add(self)
 
     def _maybe_verify(self, program: Program, feed_names, fetch_names,
@@ -469,6 +478,24 @@ class Executor:
         self._key_parts[id(program)] = (program, parts)
         while len(self._key_parts) > self._CACHE_CAP:
             self._key_parts.pop(next(iter(self._key_parts)))
+
+    def debug_snapshot(self) -> dict:
+        """Forensics view for the post-mortem black box: cached programs
+        with their compile-key components, plus what the last compile saw
+        (feed shapes, fetches).  Read-only; safe on a wedged executor."""
+        programs = []
+        for pid, (prog, parts) in list(self._key_parts.items()):
+            programs.append({
+                "program": f"{pid}:v{getattr(prog, '_version', 0)}",
+                "key_components": {k: repr(v)[:200]
+                                   for k, v in parts.items()}})
+        info = {"place": getattr(self, "place", None) and str(self.place),
+                "cached_steps": len(self._cache),
+                "programs": programs}
+        last = getattr(self, "_last_compile_info", None)
+        if last is not None:
+            info["last_compile"] = dict(last)
+        return info
 
     def _hoisted(self, program: Program):
         """Cached host-table hoist entry for ``program``:
@@ -720,6 +747,12 @@ class Executor:
                 "version": key[1], "shape": key[2], "fetches": key[3],
                 "seed": key[4], "flags": key[5], "strategy": key[6],
                 "fuse": None, "tuning": key[7]})
+            # black-box forensics: remember what the LAST compile saw
+            # (miss-time only -- zero warm-step cost)
+            self._last_compile_info = {
+                "program": f"{id(program)}:v{program._version}",
+                "feed_shapes": {n: list(s) for n, s in feed_shapes.items()},
+                "fetches": list(fetch_names)[:32], "fuse_k": None}
             compiled = self._compile(program, list(feed), fetch_names,
                                      state_in, state_out,
                                      wrapper=compiled_wrapper)
@@ -1065,6 +1098,10 @@ class Executor:
                 "version": key[1], "shape": key[2], "fetches": key[3],
                 "seed": key[4], "flags": key[5], "strategy": (),
                 "fuse": key[6], "tuning": key[7]})
+            self._last_compile_info = {
+                "program": f"{id(program)}:v{program._version}",
+                "feed_shapes": {n: list(s) for n, s in feed_shapes.items()},
+                "fetches": list(fetch_names)[:32], "fuse_k": k}
             compiled = self._compile_fused(program, list(feed), fetch_names,
                                            state_in, state_out, k,
                                            health_on, include_state)

@@ -23,6 +23,12 @@ reproduction gets the counterpart the whole-program-jit design enables:
   ``/metrics`` ``/healthz`` ``/goodput`` ``/journal``.
 - ``fleet``    -- cross-rank aggregation + straggler detection
   (``PADDLE_TPU_FLEET=gather|scrape``).
+- ``slo`` / ``alerts`` -- declarative SLO rules over the registry with
+  multi-window multi-burn-rate alerting (``PADDLE_TPU_OBS_SLO=rules.json``;
+  journal ``alert`` events, ``alerts_total{rule,severity}``,
+  ``alerts_active``, the ``/alerts`` endpoint).
+- ``blackbox`` -- post-mortem bundles on terminal failure paths
+  (``PADDLE_TPU_OBS_BLACKBOX=<dir>``; triage with ``tools/postmortem.py``).
 - ``attribution`` -- IR->HLO cost attribution per compiled program
   (``hlo_op_bytes{category}`` gauges, copy-pair blame feeding PT060,
   ``--emit-hlo`` capture) and the ``hlo_diff`` regression explainer
@@ -58,6 +64,14 @@ from .server import (ObsServer,  # noqa: F401
                      stop as stop_server)
 from .fleet import FleetMonitor, detect_stragglers  # noqa: F401
 from . import attribution  # noqa: F401
+from . import alerts  # noqa: F401
+from . import slo  # noqa: F401
+from . import blackbox  # noqa: F401
+from .alerts import Alert, AlertManager  # noqa: F401
+from .slo import (SLOEngine, SLOConfigError, Rule,  # noqa: F401
+                  load_rules, parse_rules, validate_rules,
+                  alerts_doc)
+from .blackbox import write_bundle  # noqa: F401
 from .attribution import (ProgramAttribution,  # noqa: F401
                           attribute_hlo_text, diff_attributions,
                           format_diff)

@@ -4,7 +4,10 @@ Two sinks with different costs:
 
 - an in-process ring buffer (bounded deque) that is ALWAYS on -- appending
   a dict is nanoseconds, and it lets tests and obs_report inspect recent
-  recompile/run events without any environment setup;
+  recompile/run events without any environment setup; capacity defaults
+  to 1024 and is tunable via ``PADDLE_TPU_OBS_JOURNAL_RING`` (absurd
+  values are clamped with a warning) so post-mortem bundles on long runs
+  keep the interesting tail;
 - a JSONL file sink gated on the ``PADDLE_TPU_OBS=1`` env toggle (the
   FLAGS-style switch documented in README). With the toggle unset nothing
   is opened or written -- the executor hot path performs no file I/O.
@@ -24,10 +27,37 @@ import time
 from typing import List, Optional
 
 DEFAULT_JOURNAL = "paddle_tpu_obs.jsonl"
-_RING_CAP = 1024
+RING_ENV = "PADDLE_TPU_OBS_JOURNAL_RING"
+_RING_CAP = 1024                 # default; RING_ENV overrides
+_RING_MIN, _RING_MAX = 16, 1_048_576
+
+
+def ring_capacity() -> int:
+    """The configured ring size: ``PADDLE_TPU_OBS_JOURNAL_RING`` parsed
+    with a LOUD clamp on absurd values (a 4-entry ring loses every
+    interesting tail; a billion-entry ring is an OOM, not a journal).
+    Read at import and on :func:`clear` -- never per emit."""
+    raw = os.environ.get(RING_ENV)
+    if raw is None or not raw.strip():
+        return _RING_CAP
+    try:
+        n = int(raw.strip())
+    except ValueError:
+        import warnings
+        warnings.warn(f"{RING_ENV}={raw!r} is not an integer; journal "
+                      f"ring stays at {_RING_CAP}")
+        return _RING_CAP
+    if n < _RING_MIN or n > _RING_MAX:
+        clamped = min(max(n, _RING_MIN), _RING_MAX)
+        import warnings
+        warnings.warn(f"{RING_ENV}={raw!r} clamped to {clamped} "
+                      f"(sane range [{_RING_MIN}, {_RING_MAX}])")
+        return clamped
+    return n
+
 
 _lock = threading.Lock()
-_ring: "collections.deque" = collections.deque(maxlen=_RING_CAP)
+_ring: "collections.deque" = collections.deque(maxlen=ring_capacity())
 # path -> broken: a journal path that failed to write is warned about once
 # and then skipped -- telemetry must degrade, never abort a training step
 _broken_paths = set()
@@ -148,9 +178,13 @@ def recent(n: Optional[int] = None, event: Optional[str] = None) -> List[dict]:
 
 
 def clear():
-    global _rank_cache
+    global _rank_cache, _ring
+    cap = ring_capacity()
     with _lock:
-        _ring.clear()
+        if cap != _ring.maxlen:   # env changed since import: resize
+            _ring = collections.deque(maxlen=cap)
+        else:
+            _ring.clear()
     _broken_paths.clear()
     _rank_cache = None
 

@@ -20,6 +20,10 @@ Routes:
 - ``/goodput``  -- the :mod:`goodput` ledger as JSON.
 - ``/journal``  -- bounded JSONL tail of the in-process journal ring
   (``?n=``, default 100, capped at 1000).
+- ``/alerts``   -- the SLO engine's view as JSON: parsed rules, latest
+  per-rule evaluations (burn rates per window), active and
+  recently-resolved alerts; a disarmed engine serves a stub with
+  ``"armed": false``.
 
 Failure policy: telemetry must degrade, never abort training.  A port
 already in use (or any bind error) warns ONCE per port and returns None;
@@ -107,6 +111,8 @@ def _refresh():
         from . import fleet as _fleet
         if _fleet.MONITOR is not None:
             _fleet.MONITOR.export_local()
+        from . import slo as _slo
+        _slo.run_refreshers()   # on-demand gauges (model staleness, ...)
     except Exception as e:  # telemetry must not 500 the whole scrape
         _warn_once("refresh", f"goodput/fleet refresh failed: {e}")
 
@@ -188,9 +194,16 @@ def _make_handler():
                              for e in _journal.recent(n)]
                     self._send(200, ("\n".join(lines) + "\n").encode(),
                                "application/jsonl")
+                elif parsed.path == "/alerts":
+                    from . import slo as _slo
+                    self._send(200, json.dumps(_slo.alerts_doc(),
+                                               sort_keys=True,
+                                               default=str).encode(),
+                               "application/json")
                 else:
                     self._send(404, b"not found: use /metrics, /healthz, "
-                                    b"/goodput or /journal\n", "text/plain")
+                                    b"/goodput, /journal or /alerts\n",
+                               "text/plain")
             except BrokenPipeError:
                 pass
             except Exception as e:

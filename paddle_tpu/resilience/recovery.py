@@ -52,6 +52,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import blackbox as _blackbox
 from ..observability import health as _health
 from ..observability import journal as _journal
 from ..observability.metrics import REGISTRY as _OBS
@@ -314,6 +315,11 @@ class StepGuardian:
                 raise
             except Exception as e:
                 if not is_transient(e) or attempt >= self.max_retries:
+                    _blackbox.maybe_write(
+                        "retries_exhausted" if is_transient(e)
+                        else "terminal_error", error=e,
+                        extra={"step": self.step, "attempt": attempt,
+                               "program": label})
                     raise
                 attempt += 1
                 self._backoff(attempt, transient_site(e), e)
@@ -395,6 +401,11 @@ class StepGuardian:
                 raise
             except Exception as e:
                 if not is_transient(e) or attempt >= self.max_retries:
+                    _blackbox.maybe_write(
+                        "retries_exhausted" if is_transient(e)
+                        else "terminal_error", error=e,
+                        extra={"step": self.step, "attempt": attempt,
+                               "program": label, "fused_k": k})
                     raise
                 attempt += 1
                 self._backoff(attempt, transient_site(e), e)
@@ -564,6 +575,9 @@ class StepGuardian:
         if not done.wait(self.step_timeout):
             _journal.emit({"event": "step_timeout", "step": self.step,
                            "deadline_s": self.step_timeout})
+            _blackbox.maybe_write("step_timeout",
+                                  extra={"step": self.step,
+                                         "deadline_s": self.step_timeout})
             raise StepTimeout(
                 f"step {self.step} exceeded the {self.step_timeout}s "
                 f"deadline (hung dispatch/d2h sync); restart from the "
@@ -616,9 +630,13 @@ class StepGuardian:
                                 fetches):
         policy = self.nonfinite_policy
         if policy == "raise":
-            raise FloatingPointError(
+            err = FloatingPointError(
                 f"nonfinite step {self.step}: {bad[:8]} "
                 f"(StepGuardian nonfinite_policy=raise)")
+            _blackbox.maybe_write("nonfinite", error=err,
+                                  extra={"step": self.step,
+                                         "vars": bad[:8]})
+            raise err
         # skip drops the update but keeps marching (the batch is consumed,
         # the next step draws fresh rng); rollback is a true rewind, so the
         # rng-run counter is restored too and the replay is deterministic
@@ -736,6 +754,9 @@ class StepGuardian:
                          ).inc()
         _journal.emit({"event": "preempt", "step": self.step,
                        "saved_step": saved, "reason": _preempt_reason})
+        _blackbox.maybe_write("preemption",
+                              extra={"step": self.step, "saved_step": saved,
+                                     "reason": _preempt_reason})
         self.close()
         if saved is not None:
             msg = (f"preempted ({_preempt_reason}): emergency checkpoint "

@@ -42,11 +42,13 @@ gauges, ``serving_batch_rows`` / ``serving_time_in_queue_seconds`` /
 """
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import blackbox as _blackbox
 from ..observability import journal as _journal
 from ..observability.metrics import REGISTRY as _OBS
 from ..resilience import faults as _faults
@@ -60,6 +62,11 @@ __all__ = ["TenantQueue", "PredictorPool", "ServingDtype",
 
 #: serving_batch_rows histogram buckets: pow2 row buckets up to 512
 BATCH_ROWS_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+#: respawn storm: this many worker crashes inside the window means the
+#: pool is thrashing, not recovering -- black-box it once
+STORM_CRASHES = 3
+STORM_WINDOW_S = 30.0
 
 
 # --------------------------------------------------------------- fair queue --
@@ -450,6 +457,8 @@ class PredictorPool:
         # read when unset -- same contract as the executor hook)
         from ..observability import server as _server
         _server.maybe_start()
+        from ..observability import slo as _slo
+        _slo.maybe_arm()   # one env read when PADDLE_TPU_OBS_SLO unset
         self._g_depth = _OBS.gauge(
             "serving_queue_depth", "queued serving requests")
         self._g_inflight = _OBS.gauge(
@@ -457,6 +466,20 @@ class PredictorPool:
         self._g_version = _OBS.gauge(
             "serving_model_version", "weight generation being served")
         self._g_version.set(self._model_version)
+        # model freshness: now - last successful swap finalize (the load
+        # at construction counts as generation zero's "swap").  The gauge
+        # is recomputed on demand -- each /metrics scrape and SLO
+        # evaluation runs the registered refresher -- so an idle pool
+        # still ages visibly; the serve-side twin of sample_age_seconds.
+        self._last_swap_t = self._clock.now()
+        self._g_staleness = _OBS.gauge(
+            "model_staleness_seconds",
+            "seconds since the served weights were last refreshed")
+        self._g_staleness.set(0.0)
+        _slo.register_refresher(self._export_staleness)
+        #: worker-crash timestamps for respawn-storm detection
+        self._crash_times: "collections.deque" = collections.deque(maxlen=32)
+        self._storm_reported = False
         self._h_rows = _OBS.histogram(
             "serving_batch_rows", "real rows per served batch",
             buckets=BATCH_ROWS_BUCKETS)
@@ -618,6 +641,22 @@ class PredictorPool:
                          "respawned").inc()
             _journal.emit({"event": "serve_worker_crash", "worker": idx,
                            "error": f"{type(e).__name__}: {e}"[:200]})
+            now = self._clock.now()
+            self._crash_times.append(now)
+            storm = [t for t in self._crash_times
+                     if t >= now - STORM_WINDOW_S]
+            if len(storm) >= STORM_CRASHES and not self._storm_reported:
+                # crashing faster than respawning helps: journal once and
+                # black-box the evidence (workers keep respawning -- the
+                # containment contract stands, but someone must look)
+                self._storm_reported = True
+                _journal.emit({"event": "serve_respawn_storm",
+                               "crashes": len(storm),
+                               "window_s": STORM_WINDOW_S})
+                _blackbox.maybe_write(
+                    "respawn_storm", error=e,
+                    extra={"worker": idx, "crashes": len(storm),
+                           "window_s": STORM_WINDOW_S})
             with self._lock:
                 if self._stopped:
                     return
@@ -816,6 +855,14 @@ class PredictorPool:
         when a swap has rotated every predictor)."""
         return self._model_version
 
+    def model_staleness_seconds(self) -> float:
+        """Seconds since the served weights were last refreshed (the
+        construction-time load counts as the first refresh)."""
+        return max(0.0, self._clock.now() - self._last_swap_t)
+
+    def _export_staleness(self) -> None:
+        self._g_staleness.set(round(self.model_staleness_seconds(), 3))
+
     def swap(self, model_dir: Optional[str] = None, *,
              state: Optional[Dict[str, object]] = None,
              verify: bool = True, wait: bool = True,
@@ -890,6 +937,8 @@ class PredictorPool:
             if t0 is None:
                 t0 = getattr(self, "_swap_t0", None)
         self._g_version.set(target)
+        self._last_swap_t = self._clock.now()
+        self._g_staleness.set(0.0)
         _OBS.counter("serving_swap_total", "hot swaps by outcome",
                      outcome="ok").inc()
         ev = {"event": "serve_swap", "outcome": "ok",
@@ -1022,6 +1071,10 @@ class PredictorPool:
                        "failed_queued": n_queued,
                        "failed_in_flight": n_inflight,
                        "waited_s": waited_s})
+        _blackbox.maybe_write(
+            "serve_drain_timeout",
+            extra={"failed_queued": n_queued,
+                   "failed_in_flight": n_inflight, "waited_s": waited_s})
 
     def __enter__(self):
         return self

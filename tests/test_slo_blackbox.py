@@ -1,0 +1,862 @@
+"""SLO engine, burn-rate alerting, post-mortem black box (ISSUE 17).
+
+The load-bearing claims pinned here:
+
+- declarative rules over the existing metric families parse, validate
+  (typed ``SLOConfigError``; ``ci_lint`` rejects unknown metrics and
+  inverted windows), and evaluate against live registry snapshots --
+  counters, gauges, histogram quantiles (per-label-group fan-out), and
+  counter rates;
+- multi-window multi-burn-rate alerting NEVER pages on a single sample:
+  a windowed rule fires only once the series spans the short window and
+  the burn rate clears the factor in BOTH windows, and resolves as soon
+  as the short window goes quiet; instant rules fire/resolve directly;
+- arming is env/API gated exactly like every other observability
+  subsystem: ``PADDLE_TPU_OBS_SLO`` unset costs ONE env read at
+  Executor/PredictorPool construction -- no thread, no file open, no
+  engine (subprocess spy guard);
+- the chaos drive: a seeded run under ``nan`` + ``exc@dispatch`` faults
+  plus a wedged serving worker fires exactly the matching SLO alerts
+  (burn windows asserted; a clean control evaluation fires nothing),
+  the terminal failure paths write an atomic post-mortem bundle, and
+  ``tools/postmortem.py`` names the true root cause from the bundle
+  alone;
+- satellites: ``model_staleness_seconds`` beside ``model_version``,
+  env-configurable journal ring with a loud clamp, bench-sentinel
+  findings journaled as ``bench_regression`` events, the ``/alerts``
+  endpoint, and the tool selftest pins.
+
+Hermetic tier: engine math runs on fresh ``MetricsRegistry`` objects with
+explicit ``evaluate(now=t)`` fake times; serving legs use ``FakeClock`` +
+``start_workers=False``.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.observability import blackbox, journal, server, slo
+from paddle_tpu.observability.alerts import INSTANT, AlertManager
+from paddle_tpu.observability.metrics import REGISTRY, MetricsRegistry
+from paddle_tpu.resilience import StepGuardian, faults, recovery
+from paddle_tpu.serving import FakeClock, PredictorPool, RequestShed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULES_FMT = "paddle_tpu_slo_rules_v1"
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    """Every test starts and ends disarmed: no engine, no poller, no
+    faults, a fresh journal ring, and a reset bundle budget."""
+    slo.disarm()
+    faults.clear()
+    blackbox.reset()
+    journal.clear()
+    yield
+    slo.disarm()
+    faults.clear()
+    blackbox.reset(written_cap=8)
+    journal.clear()
+    recovery.clear_preemption()
+
+
+def _train_program(dim=4, seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data("x", [dim], "float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, dim))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(dim=4, step=0):
+    return {"x": np.full((2, dim), 1.0 + 0.1 * step, "float32")}
+
+
+def _doc(*rules):
+    return {"format": RULES_FMT, "rules": list(rules)}
+
+
+def _family_total(name):
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    return sum(c.value for c in fam.children.values())
+
+
+class FakePredictor:
+    """Row-wise out = x * mult with the hot-swap protocol."""
+
+    def __init__(self, mult=2.0):
+        self.mult = float(mult)
+        self.model_version = 1
+
+    def run(self, feed, dtype=None):
+        return [feed["x"] * self.mult]
+
+    def swap_state(self, state, validate_only=False, model_version=None):
+        if "mult" not in state:
+            raise ValueError("swap_state missing parameter 'mult'")
+        if validate_only:
+            return
+        self.mult = float(np.asarray(state["mult"]))
+        if model_version is not None:
+            self.model_version = int(model_version)
+
+
+class GatedFake:
+    """Predictor whose run() blocks on a gate (wedged-worker drills)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.started = threading.Event()
+
+    def run(self, feed, dtype=None):
+        self.started.set()
+        assert self.gate.wait(30), "test gate never opened"
+        return [feed["x"] * 2.0]
+
+    def swap_state(self, state, validate_only=False, model_version=None):
+        pass
+
+
+def hermetic_pool(preds, clock, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 0.0)
+    kw.setdefault("max_queue", 64)
+    return PredictorPool(predictors=preds, clock=clock,
+                        start_workers=False, **kw)
+
+
+def serve_feed(rows=1, dim=4, fill=1.0):
+    return {"x": np.full((rows, dim), fill, "float32")}
+
+
+# ------------------------------------------------------- rules & parsing --
+
+def test_parse_threshold_durations():
+    for raw, want in (("25ms", 0.025), ("60s", 60.0), ("1m", 60.0),
+                      ("2h", 7200.0), ("150us", 150e-6), (0.85, 0.85),
+                      ("0.85", 0.85)):
+        assert slo.parse_threshold(raw) == pytest.approx(want), raw
+    with pytest.raises(slo.SLOConfigError):
+        slo.parse_threshold("25 parsecs")
+
+
+def test_parse_metric_spec_groups_and_filters():
+    assert slo.parse_metric_spec("goodput_fraction") == \
+        ("goodput_fraction", [], {})
+    name, by, filt = slo.parse_metric_spec("serving_request_seconds{tenant}")
+    assert (name, by, filt) == ("serving_request_seconds", ["tenant"], {})
+    name, by, filt = slo.parse_metric_spec(
+        'serving_request_seconds{tenant="chaos"}')
+    assert (name, by, filt) == \
+        ("serving_request_seconds", [], {"tenant": "chaos"})
+
+
+def test_parse_objective_with_and_without_agg():
+    assert slo.parse_objective("p99 <= 25ms") == ("p99", "<=", 0.025)
+    assert slo.parse_objective(">= 0.85") == (None, ">=", 0.85)
+    assert slo.parse_objective("== 0") == (None, "==", 0.0)
+    with pytest.raises(slo.SLOConfigError):
+        slo.parse_objective("about 7")
+
+
+def test_validate_rules_catches_the_lies():
+    known = ("goodput_fraction",)
+    # wrong format marker
+    assert slo.validate_rules({"format": "nope", "rules": []})
+    # duplicate ids
+    r = {"id": "a", "metric": "goodput_fraction", "objective": ">= 0.5"}
+    probs = slo.validate_rules(_doc(r, dict(r)), known=known)
+    assert any("duplicate" in p for p in probs)
+    # inverted window
+    probs = slo.validate_rules(_doc(
+        {"id": "w", "metric": "goodput_fraction", "objective": ">= 0.5",
+         "windows": [{"long_s": 60, "short_s": 300, "burn": 2.0}]}),
+        known=known)
+    assert any("short_s must be < long_s" in p for p in probs)
+    # unknown metric family, only when a known list is supplied
+    probs = slo.validate_rules(_doc(
+        {"id": "t", "metric": "goodput_fractoin", "objective": ">= 0.5"}),
+        known=known)
+    assert any("goodput_fractoin" in p for p in probs)
+    # budget outside (0, 1]
+    probs = slo.validate_rules(_doc(
+        {"id": "b", "metric": "goodput_fraction", "objective": ">= 0.5",
+         "error_budget": 0.0}), known=known)
+    assert any("error_budget" in p for p in probs)
+    # a clean doc validates clean
+    assert slo.validate_rules(_doc(
+        {"id": "ok", "metric": "goodput_fraction", "objective": ">= 0.5"}),
+        known=known) == []
+
+
+def test_parse_rules_raises_typed_and_is_a_valueerror():
+    with pytest.raises(slo.SLOConfigError):
+        slo.parse_rules({"format": "nope", "rules": []})
+    assert issubclass(slo.SLOConfigError, ValueError)
+
+
+def test_shipped_example_rules_load_against_known_families():
+    rules = slo.load_rules(os.path.join(REPO, "examples", "slo_rules.json"))
+    assert {r.id for r in rules} >= {"training-goodput",
+                                     "serving-latency-p99",
+                                     "no-nonfinite-tensors"}
+    with open(os.path.join(REPO, "examples", "slo_rules.json")) as f:
+        doc = json.load(f)
+    assert slo.validate_rules(doc, known=slo.known_metric_families()) == []
+    # the known-family scan actually found the real registries
+    fams = slo.known_metric_families()
+    assert "goodput_fraction" in fams and \
+        "serving_request_seconds" in fams
+
+
+# ----------------------------------------------------------- engine math --
+
+def _engine(reg, *rules):
+    return slo.SLOEngine(slo.parse_rules(_doc(*rules)), registry=reg)
+
+
+def test_instant_rule_fires_and_resolves_on_gauge():
+    reg = MetricsRegistry()
+    g = reg.gauge("serving_queue_depth")
+    eng = _engine(reg, {"id": "shallow-queue",
+                        "metric": "serving_queue_depth",
+                        "objective": "<= 2", "severity": "page"})
+    g.set(1)
+    assert eng.evaluate(now=0.0) == []
+    n_alerts = len(journal.recent(event="alert"))
+    g.set(5)
+    active = eng.evaluate(now=1.0)
+    assert [a.rule for a in active] == ["shallow-queue"]
+    a = active[0]
+    assert a.window == INSTANT and a.observed == 5.0 and a.burn is None
+    assert reg.counter("alerts_total", rule="shallow-queue",
+                       severity="page").value == 1
+    assert reg.gauge("alerts_active").value == 1.0
+    # re-firing refreshes, never double-journals or double-counts
+    g.set(7)
+    eng.evaluate(now=2.0)
+    assert reg.counter("alerts_total", rule="shallow-queue",
+                       severity="page").value == 1
+    evs = journal.recent(event="alert")
+    assert len(evs) == n_alerts + 1 and evs[-1]["state"] == "firing"
+    g.set(0)
+    assert eng.evaluate(now=3.0) == []
+    assert reg.gauge("alerts_active").value == 0.0
+    evs = journal.recent(event="alert")
+    assert evs[-1]["state"] == "resolved" and evs[-1]["observed"] == 0.0
+    assert eng.alerts.history()[-1].rule == "shallow-queue"
+
+
+def test_burn_windows_no_single_sample_page_then_fire_then_resolve():
+    """The MWMBR contract end to end on a fake clock: a violating gauge
+    pages only once the series covers the short window with the burn
+    over threshold in BOTH windows, and recovers when the short window
+    goes quiet."""
+    reg = MetricsRegistry()
+    g = reg.gauge("goodput_fraction")
+    eng = _engine(reg, {"id": "training-goodput",
+                        "metric": "goodput_fraction",
+                        "objective": ">= 0.85", "severity": "page",
+                        "error_budget": 0.01,
+                        "windows": [{"long_s": 300, "short_s": 60,
+                                     "burn": 14.4}]})
+    g.set(0.20)                                # hard violation from t=0
+    for t in (0.0, 15.0, 30.0, 45.0):
+        assert eng.evaluate(now=t) == [], \
+            f"paged at t={t} before the 60s short window was covered"
+    active = eng.evaluate(now=60.0)
+    assert [a.rule for a in active] == ["training-goodput"]
+    a = active[0]
+    assert a.window == "300s/60s" and a.severity == "page"
+    # every sample violates: burn = 1.0 violating-fraction / 0.01 budget
+    assert a.burn == pytest.approx(100.0)
+    ev = journal.recent(event="alert")[-1]
+    assert ev["state"] == "firing" and ev["window"] == "300s/60s" \
+        and ev["burn"] == pytest.approx(100.0)
+    # recovery: the short window must empty of violations to resolve
+    g.set(0.95)
+    t, resolved_at = 60.0, None
+    while t < 300.0:
+        t += 10.0
+        if not eng.evaluate(now=t):
+            resolved_at = t
+            break
+    assert resolved_at is not None, "alert never resolved after recovery"
+    # 60s short window forgets the violations ~60s after the last one
+    assert resolved_at <= 130.0
+    assert journal.recent(event="alert")[-1]["state"] == "resolved"
+
+
+def test_clean_control_never_fires():
+    reg = MetricsRegistry()
+    g = reg.gauge("goodput_fraction")
+    eng = _engine(reg, {"id": "training-goodput",
+                        "metric": "goodput_fraction",
+                        "objective": ">= 0.85",
+                        "windows": [{"long_s": 300, "short_s": 60,
+                                     "burn": 14.4}]})
+    g.set(0.93)
+    for t in range(0, 400, 10):
+        assert eng.evaluate(now=float(t)) == []
+    assert reg.get("alerts_total") is None
+
+
+def test_histogram_p99_fans_out_per_label_group():
+    """One rule over ``serving_request_seconds{tenant}``: only the slow
+    tenant's group fires, carrying its labels."""
+    reg = MetricsRegistry()
+    slow = reg.histogram("serving_request_seconds", tenant="slow")
+    fast = reg.histogram("serving_request_seconds", tenant="fast")
+    eng = _engine(reg, {"id": "serving-latency-p99",
+                        "metric": "serving_request_seconds{tenant}",
+                        "objective": "p99 <= 25ms", "severity": "page",
+                        "error_budget": 0.05,
+                        "windows": [{"long_s": 300, "short_s": 60,
+                                     "burn": 6.0}]})
+    for t in range(0, 91, 15):
+        slow.observe(0.050)
+        fast.observe(0.002)
+        active = eng.evaluate(now=float(t))
+    assert [(a.rule, a.labels) for a in active] == \
+        [("serving-latency-p99", {"tenant": "slow"})]
+    # burn: all samples violating / 0.05 budget = 20, over the 6.0 factor
+    assert active[0].burn == pytest.approx(20.0)
+    assert active[0].observed > 0.025
+
+
+def test_rule_without_data_never_fires_and_reports_no_data():
+    reg = MetricsRegistry()
+    eng = _engine(reg, {"id": "ghost", "metric": "no_such_family",
+                        "objective": "<= 1"})
+    assert eng.evaluate(now=0.0) == []
+    assert eng.to_doc()["evaluations"]["ghost"]["no_data"] is True
+
+
+def test_counter_rate_aggregation():
+    reg = MetricsRegistry()
+    c = reg.counter("stream_records_total")
+    eng = _engine(reg, {"id": "ingest-rate",
+                        "metric": "stream_records_total",
+                        "objective": "rate >= 5"})
+    c.inc(100)
+    assert eng.evaluate(now=0.0) == []        # first sample: no delta yet
+    c.inc(100)                                 # 100 in 10s -> 10/s, fine
+    assert eng.evaluate(now=10.0) == []
+    c.inc(10)                                  # 10 in 10s -> 1/s: violates
+    active = eng.evaluate(now=20.0)
+    assert [a.rule for a in active] == ["ingest-rate"]
+    assert active[0].observed == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------- arming ----
+
+def test_maybe_arm_disarmed_returns_none(monkeypatch):
+    monkeypatch.delenv(slo.SLO_ENV, raising=False)
+    assert slo.maybe_arm() is None and slo.ENGINE is None
+
+
+def test_env_arms_engine_and_poller_at_executor_construction(
+        monkeypatch, tmp_path):
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps(_doc(
+        {"id": "no-nonfinite", "metric": "tensor_nonfinite_total",
+         "objective": "== 0"})))
+    monkeypatch.setenv(slo.SLO_ENV, str(rules))
+    monkeypatch.setenv(slo.INTERVAL_ENV, "60")
+    try:
+        fluid.Executor()
+        assert slo.ENGINE is not None
+        assert [r.id for r in slo.ENGINE.rules] == ["no-nonfinite"]
+        armed = journal.recent(event="slo_armed")
+        assert armed and armed[-1]["rules"] == ["no-nonfinite"] \
+            and armed[-1]["interval_s"] == 60.0 and armed[-1]["poller"]
+        assert any(t.name == "paddle-tpu-slo" and t.daemon
+                   for t in threading.enumerate())
+        # idempotent: a second construction does not re-arm
+        eng = slo.ENGINE
+        fluid.Executor()
+        assert slo.ENGINE is eng
+        assert len(journal.recent(event="slo_armed")) == 1
+    finally:
+        slo.disarm()
+    assert not any(t.name == "paddle-tpu-slo"
+                   for t in threading.enumerate())
+
+
+def test_bad_rules_file_fails_loud_at_construction(monkeypatch, tmp_path):
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps(_doc(
+        {"id": "w", "metric": "goodput_fraction", "objective": ">= 0.5",
+         "windows": [{"long_s": 60, "short_s": 300, "burn": 2.0}]})))
+    monkeypatch.setenv(slo.SLO_ENV, str(rules))
+    with pytest.raises(slo.SLOConfigError, match="short_s"):
+        fluid.Executor()
+
+
+def test_predictor_pool_construction_arms_too(monkeypatch, tmp_path):
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps(_doc(
+        {"id": "fresh", "metric": "model_staleness_seconds",
+         "objective": "<= 3600"})))
+    monkeypatch.setenv(slo.SLO_ENV, str(rules))
+    pool = hermetic_pool([FakePredictor()], FakeClock())
+    try:
+        assert slo.ENGINE is not None
+        assert [r.id for r in slo.ENGINE.rules] == ["fresh"]
+    finally:
+        pool.close()
+        slo.disarm()
+
+
+def test_alerts_endpoint_serves_engine_state(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OBS_PORT", "0")   # ephemeral port
+    srv = server.start()
+    assert srv is not None
+    try:
+        # disarmed: a stub, not an error
+        doc = json.load(urllib.request.urlopen(srv.url + "/alerts"))
+        assert doc == {"armed": False, "rules": [], "evaluations": {},
+                       "active": [], "recent_resolved": []}
+        # armed + firing: rules, evaluations, and the active alert
+        REGISTRY.gauge("serving_queue_depth").set(9)
+        eng = slo.arm(_doc({"id": "shallow-queue",
+                            "metric": "serving_queue_depth",
+                            "objective": "<= 2", "severity": "page"}),
+                      start_poller=False)
+        eng.evaluate(now=1.0)
+        doc = json.load(urllib.request.urlopen(srv.url + "/alerts"))
+        assert doc["armed"] is True
+        assert [r["id"] for r in doc["rules"]] == ["shallow-queue"]
+        assert [a["rule"] for a in doc["active"]] == ["shallow-queue"]
+        assert doc["active"][0]["observed"] == 9.0
+        assert "shallow-queue" in doc["evaluations"]
+        # resolve -> lands in recent_resolved
+        REGISTRY.gauge("serving_queue_depth").set(0)
+        eng.evaluate(now=2.0)
+        doc = json.load(urllib.request.urlopen(srv.url + "/alerts"))
+        assert doc["active"] == []
+        assert [a["rule"] for a in doc["recent_resolved"]] == \
+            ["shallow-queue"]
+    finally:
+        server.stop()
+        REGISTRY.gauge("serving_queue_depth").set(0)
+
+
+# ------------------------------------------------------------ black box --
+
+def test_blackbox_disarmed_writes_nothing(monkeypatch):
+    monkeypatch.delenv(blackbox.BLACKBOX_ENV, raising=False)
+    assert blackbox.armed_dir() is None
+    assert blackbox.maybe_write("probe") is None
+
+
+def test_blackbox_truthy_spells_default_dir(monkeypatch):
+    monkeypatch.setenv(blackbox.BLACKBOX_ENV, "1")
+    assert blackbox.armed_dir() == blackbox.DEFAULT_DIR
+    monkeypatch.setenv(blackbox.BLACKBOX_ENV, "0")
+    assert blackbox.armed_dir() is None
+    monkeypatch.setenv(blackbox.BLACKBOX_ENV, "/tmp/somewhere")
+    assert blackbox.armed_dir() == "/tmp/somewhere"
+
+
+def test_bundle_budget_is_capped(tmp_path):
+    blackbox.reset(written_cap=2)
+    try:
+        assert blackbox.maybe_write("a", base_dir=str(tmp_path)) is not None
+        assert blackbox.maybe_write("b", base_dir=str(tmp_path)) is not None
+        assert blackbox.maybe_write("c", base_dir=str(tmp_path)) is None
+        assert len(os.listdir(tmp_path)) == 2
+    finally:
+        blackbox.reset(written_cap=8)
+
+
+def test_bundle_is_atomic_and_self_describing(tmp_path):
+    bdir = blackbox.write_bundle(
+        "unit", error=RuntimeError("boom"), extra={"step": 7},
+        base_dir=str(tmp_path))
+    assert bdir is not None
+    names = os.listdir(bdir)
+    assert names == ["bundle.json"], "tmp file leaked or bundle missing"
+    with open(os.path.join(bdir, "bundle.json")) as f:
+        doc = json.load(f)
+    assert doc["format"] == blackbox.FORMAT
+    assert doc["reason"] == "unit" and doc["extra"]["step"] == 7
+    assert doc["error"] == {"type": "RuntimeError", "message": "boom"}
+    for section in ("journal", "timeline", "metrics", "alerts",
+                    "executors", "attribution"):
+        assert section in doc, f"section {section} missing"
+    assert _family_total("postmortem_bundles_total") >= 1
+    evs = journal.recent(event="postmortem")
+    assert evs and evs[-1]["reason"] == "unit" \
+        and evs[-1]["path"].endswith("bundle.json")
+
+
+def test_bundle_on_step_timeout(monkeypatch, tmp_path):
+    monkeypatch.setenv(blackbox.BLACKBOX_ENV, str(tmp_path / "pm"))
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, step_timeout=0.4)
+        g.run(feed=_feed(), fetch_list=[loss])   # compile outside the hang
+        faults.install("hang@fetch:seconds=30")
+        with pytest.raises(recovery.StepTimeout):
+            g.run(feed=_feed(), fetch_list=[loss])
+    docs = []
+    for b in glob.glob(str(tmp_path / "pm" / "postmortem-*")):
+        with open(os.path.join(b, "bundle.json")) as f:
+            docs.append(json.load(f))
+    # the timeout site black-boxes first; the guardian's terminal raise
+    # (StepTimeout is non-transient) adds its own bundle
+    by_reason = {d["reason"]: d for d in docs}
+    assert "step_timeout" in by_reason, sorted(by_reason)
+    assert by_reason["step_timeout"]["extra"]["deadline_s"] == 0.4
+
+
+def test_bundle_on_respawn_storm(monkeypatch, tmp_path):
+    """Three worker crashes inside the storm window journal
+    ``serve_respawn_storm`` once and black-box the evidence, while the
+    containment contract (respawn, keep serving) still holds."""
+    monkeypatch.setenv(blackbox.BLACKBOX_ENV, str(tmp_path / "pm"))
+    faults.install("exc@serve_hang:times=3")
+    pool = PredictorPool(predictors=[FakePredictor()], max_batch=4,
+                        max_wait_ms=0.0)
+    try:
+        out, = pool.run(serve_feed(fill=2.0), timeout=30)
+        assert np.allclose(out, 4.0)           # still serving after storm
+        storms = journal.recent(event="serve_respawn_storm")
+        assert len(storms) == 1 and storms[0]["crashes"] >= 3
+        bundles = glob.glob(str(tmp_path / "pm" / "postmortem-*"))
+        assert bundles, "respawn storm wrote no bundle"
+        with open(os.path.join(bundles[0], "bundle.json")) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "respawn_storm"
+        assert doc["extra"]["crashes"] >= 3
+    finally:
+        faults.clear()
+        pool.close()
+
+
+# -------------------------------------------------------- the chaos drive --
+
+def test_chaos_drive_end_to_end(monkeypatch, tmp_path):
+    """The acceptance drill: one seeded run under ``nan`` +
+    ``exc@dispatch`` faults and a wedged serving worker fires exactly the
+    matching SLO alerts (and nothing on the clean control evaluation),
+    the exhausted retry budget writes a post-mortem bundle, and
+    ``tools/postmortem.py`` names the true root cause from the bundle
+    alone."""
+    pm_dir = tmp_path / "pm"
+    monkeypatch.setenv(blackbox.BLACKBOX_ENV, str(pm_dir))
+    monkeypatch.setenv("PADDLE_TPU_OBS_HEALTH", "warn")
+
+    # thresholds baselined against the process-global registry so the
+    # drill is exact under any suite ordering
+    n0 = int(_family_total("tensor_nonfinite_total"))
+    engine = slo.arm(_doc(
+        {"id": "no-nonfinite-tensors", "metric": "tensor_nonfinite_total",
+         "objective": f"== {n0}", "severity": "page"},
+        {"id": "serving-latency-p99",
+         "metric": 'serving_request_seconds{tenant="chaos"}',
+         "objective": "p99 <= 25ms", "severity": "page",
+         "error_budget": 0.05,
+         "windows": [{"long_s": 300, "short_s": 60, "burn": 6.0}]},
+        {"id": "model-freshness", "metric": "model_staleness_seconds",
+         "objective": "<= 3600", "severity": "ticket"}),
+        start_poller=False)
+
+    clock = FakeClock()
+    fp = FakePredictor()
+    pool = hermetic_pool([fp], clock)                # exports staleness
+    ts = 1000.0
+
+    # clean control: nothing fires before any fault is injected
+    assert engine.evaluate(now=ts) == [], \
+        "clean control evaluation false-fired"
+
+    # --- leg 1: training under a nan fault (watchdog in warn mode) -----
+    main, startup, loss = _train_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, nonfinite_policy="skip")
+        faults.install(f"nan:step=1:var={loss.name}")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(3):
+                g.run(feed=_feed(), fetch_list=[loss])
+    faults.clear()
+    assert _family_total("tensor_nonfinite_total") > n0
+    active = engine.evaluate(now=ts + 5.0)
+    assert [a.rule for a in active] == ["no-nonfinite-tensors"]
+    assert active[0].window == INSTANT
+
+    # --- leg 2: a wedged serving worker makes tenant latency blow the
+    # p99 objective; the burn clears 6x in both windows only after the
+    # short window is covered (asserted: no page on the first sample) ---
+    fired_at = None
+    for i in range(8):
+        t = ts + 10.0 + 15.0 * i
+        r = pool.submit(serve_feed(), tenant="chaos")
+        clock.advance(0.050)                     # 50ms >> the 25ms SLO
+        pool._serve_once(0, fp)
+        np.testing.assert_allclose(r.result(timeout=0)[0], 2.0)
+        rules_firing = {a.rule for a in engine.evaluate(now=t)}
+        if "serving-latency-p99" in rules_firing:
+            fired_at = t
+            break
+        assert t - (ts + 10.0) < 60.0, \
+            "latency SLO never fired after the short window was covered"
+    assert fired_at is not None and fired_at - (ts + 10.0) >= 60.0
+    latency = [a for a in engine.alerts.active()
+               if a.rule == "serving-latency-p99"][0]
+    assert latency.window == "300s/60s"
+    assert latency.burn == pytest.approx(20.0)   # 1.0 violating / 0.05
+    assert latency.observed > 0.025
+
+    # exactly the matching alerts -- the freshness rule has data (the
+    # pool exports model_staleness_seconds) and stays quiet
+    assert {a.rule for a in engine.alerts.active()} == \
+        {"no-nonfinite-tensors", "serving-latency-p99"}
+    assert engine.to_doc()["evaluations"]["model-freshness"]["no_data"] \
+        is False
+
+    # --- leg 3: exc@dispatch exhausts the retry budget -> terminal raise
+    # writes the black-box bundle with the full story ------------------
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        g = StepGuardian(exe, main, max_retries=1, retry_backoff=0.001)
+        faults.install("exc@dispatch:times=0")
+        with pytest.raises(faults.TransientFault):
+            g.run(feed=_feed(), fetch_list=[loss])
+    faults.clear()
+    # the wedged worker also fails the drain typed on close
+    wedged = GatedFake()
+    wpool = PredictorPool(predictors=[wedged], max_batch=1,
+                         max_wait_ms=0.0)
+    held = wpool.submit(serve_feed())
+    assert wedged.started.wait(10)
+    wpool.close(drain=True, drain_timeout=0.2)
+    with pytest.raises(RequestShed):
+        held.result(timeout=0)
+    wedged.gate.set()
+
+    bundles = sorted(glob.glob(str(pm_dir / "postmortem-*")))
+    reasons = {}
+    for b in bundles:
+        with open(os.path.join(b, "bundle.json")) as f:
+            reasons[json.load(f)["reason"]] = b
+    assert "retries_exhausted" in reasons, f"bundles: {sorted(reasons)}"
+    assert "serve_drain_timeout" in reasons, f"bundles: {sorted(reasons)}"
+
+    # --- the triage CLI names the true root cause from the bundle alone
+    sys.path.insert(0, REPO)
+    from tools import postmortem as pm_cli
+    bundle = pm_cli.load_bundle(reasons["retries_exhausted"])
+    assert bundle["extra"]["attempt"] == 1 and bundle["extra"]["step"] == 0
+    assert [a["rule"] for a in bundle["alerts"]["active"]] == \
+        ["no-nonfinite-tensors", "serving-latency-p99"]
+    assert bundle["executors"], "bundle lost the executor compile keys"
+    assert any(e.get("last_compile") for e in bundle["executors"])
+    causes = pm_cli.probable_causes(bundle)
+    assert causes and "injected fault" in causes[0]["cause"]
+    assert "exc@dispatch" in causes[0]["cause"]
+    report = pm_cli.render(pm_cli.triage(bundle))
+    assert "retries_exhausted" in report
+    assert "FIRING" in report and "serving-latency-p99" in report
+
+    # ... and through the real CLI process, given only the bundle path
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "postmortem.py"),
+         reasons["retries_exhausted"], "--format", "json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert "injected fault" in out["probable_causes"][0]["cause"]
+    pool.close()
+
+
+# ------------------------------------------------------ zero-overhead guard --
+
+@pytest.mark.smoke
+def test_disarmed_slo_and_blackbox_cost_one_env_read():
+    """With PADDLE_TPU_OBS_SLO / PADDLE_TPU_OBS_BLACKBOX unset,
+    Executor + PredictorPool construction reads each env exactly once,
+    spawns no poller thread, opens no files on the warm step, and leaves
+    ENGINE unarmed (subprocess so sibling tests can't pre-arm)."""
+    script = r"""
+import builtins, os, sys, threading
+for v in ("PADDLE_TPU_OBS_SLO", "PADDLE_TPU_OBS_BLACKBOX"):
+    os.environ.pop(v, None)
+import numpy as np
+
+reads = {"PADDLE_TPU_OBS_SLO": 0, "PADDLE_TPU_OBS_BLACKBOX": 0}
+
+class SpyEnviron:
+    def __init__(self, real): self._real = real
+    def get(self, key, *a):
+        if key in reads: reads[key] += 1
+        return self._real.get(key, *a)
+    def __getitem__(self, key):
+        if key in reads: reads[key] += 1
+        return self._real[key]
+    def __setitem__(self, key, val): self._real[key] = val
+    def __delitem__(self, key): del self._real[key]
+    def __contains__(self, key): return key in self._real
+    def __iter__(self): return iter(self._real)
+    def __len__(self): return len(self._real)
+    def __getattr__(self, name): return getattr(self._real, name)
+
+import paddle_tpu as fluid
+from paddle_tpu.observability import blackbox, slo
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.data("x", [4], "float32")
+    loss = fluid.layers.mean(fluid.layers.fc(x, 4))
+exe = fluid.Executor()
+exe.run(startup)
+feed = {"x": np.ones((2, 4), "float32")}
+exe.run(main, feed=feed, fetch_list=[loss])      # warm the cache
+
+os.environ = SpyEnviron(os.environ)
+before = set(threading.enumerate())
+opened = []
+real_open = builtins.open
+builtins.open = lambda *a, **k: (opened.append(a[0] if a else k),
+                                 real_open(*a, **k))[1]
+try:
+    exe2 = fluid.Executor()                      # the SLO arming hook
+    exe.run(main, feed=feed, fetch_list=[loss])  # warm step: no I/O
+finally:
+    builtins.open = real_open
+assert reads["PADDLE_TPU_OBS_SLO"] == 1, reads
+assert reads["PADDLE_TPU_OBS_BLACKBOX"] == 0, reads
+assert slo.ENGINE is None and slo.POLLER is None
+new = {t for t in set(threading.enumerate()) - before if t.is_alive()}
+assert not new, f"construction leaked threads: {new}"
+assert not any(t.name == "paddle-tpu-slo" for t in threading.enumerate())
+assert not opened, f"disarmed hot path opened files: {opened}"
+assert blackbox.maybe_write("probe") is None     # one env read, no file
+assert reads["PADDLE_TPU_OBS_BLACKBOX"] == 1, reads
+os.environ = os.environ._real
+print("GUARD-OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_OBS_SLO", None)
+    env.pop("PADDLE_TPU_OBS_BLACKBOX", None)
+    r = subprocess.run([sys.executable, "-c", script], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "GUARD-OK" in r.stdout
+
+
+# ------------------------------------------------------------- satellites --
+
+def test_model_staleness_gauge_tracks_swaps():
+    """``model_staleness_seconds`` sits beside ``model_version``: grows
+    with the serving clock, is refreshed through the SLO refresher hook,
+    and snaps back to zero when a hot swap lands."""
+    clock = FakeClock()
+    fake = FakePredictor(mult=2.0)
+    pool = hermetic_pool([fake], clock)
+    try:
+        g = REGISTRY.gauge("model_staleness_seconds")
+        assert pool.model_staleness_seconds() == 0.0
+        clock.advance(12.5)
+        assert pool.model_staleness_seconds() == pytest.approx(12.5)
+        slo.run_refreshers()                     # the per-scrape hook
+        assert g.value == pytest.approx(12.5)
+        pool.swap(state={"mult": np.float32(3.0)})
+        r = pool.submit(serve_feed())
+        pool._serve_once(0, fake)                # rotation lands here
+        np.testing.assert_allclose(r.result(timeout=0)[0], 3.0)
+        assert pool.model_version == 2
+        assert pool.model_staleness_seconds() == 0.0
+        assert g.value == 0.0
+    finally:
+        pool.close()
+
+
+def test_journal_ring_capacity_env(monkeypatch):
+    # default
+    monkeypatch.delenv(journal.RING_ENV, raising=False)
+    journal.clear()
+    for i in range(1100):
+        journal.emit({"event": "tick", "i": i})
+    assert len(journal.recent()) == 1024
+    # configured
+    monkeypatch.setenv(journal.RING_ENV, "64")
+    journal.clear()
+    for i in range(100):
+        journal.emit({"event": "tick", "i": i})
+    got = journal.recent()
+    assert len(got) == 64 and got[-1]["i"] == 99 and got[0]["i"] == 36
+    # absurdly small: LOUD clamp to the floor
+    monkeypatch.setenv(journal.RING_ENV, "4")
+    with pytest.warns(UserWarning, match="clamped to 16"):
+        journal.clear()
+    for i in range(40):
+        journal.emit({"event": "tick", "i": i})
+    assert len(journal.recent()) == 16
+    # non-integer: LOUD fall back to the default
+    monkeypatch.setenv(journal.RING_ENV, "banana")
+    with pytest.warns(UserWarning, match="not an integer"):
+        journal.clear()
+    monkeypatch.delenv(journal.RING_ENV)
+    journal.clear()
+    assert journal.ring_capacity() == 1024
+
+
+def test_bench_sentinel_findings_are_journaled(tmp_path):
+    from tools import bench_compare
+    for rnd, val in (("01", 1000.0), ("02", 650.0)):
+        with open(tmp_path / f"BENCH_SELF_r{rnd}.json", "w") as f:
+            f.write(json.dumps({"metric": "m_tokens_per_sec",
+                                "value": val,
+                                "device_kind": "tpu"}) + "\n")
+    c0 = _family_total("bench_regressions_total")
+    res = bench_compare.compare_files(
+        sorted(str(tmp_path / f"BENCH_SELF_r{r}.json")
+               for r in ("01", "02")))
+    assert res["findings"], "the -35% drop produced no finding"
+    evs = journal.recent(event="bench_regression")
+    assert evs and evs[-1]["metric"] == "m_tokens_per_sec"
+    assert evs[-1]["kind"] == "cross_round" and evs[-1]["pct"] < -30.0
+    assert _family_total("bench_regressions_total") > c0
+
+
+def test_ci_lint_validates_shipped_slo_rules():
+    sys.path.insert(0, REPO)
+    from tools import ci_lint
+    paths = ci_lint.slo_rule_files()
+    assert any(p.endswith("slo_rules.json") for p in paths)
+    assert ci_lint.lint_slo() == []
+
+
+@pytest.mark.parametrize("tool", ["postmortem", "ci_lint"])
+def test_tool_selftests_pinned(tool):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", f"{tool}.py"),
+                        "--selftest"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert f"{tool} selftest: OK" in r.stdout

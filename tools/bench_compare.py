@@ -228,14 +228,41 @@ def render(series, findings, suppressed: int = 0,
     return lines
 
 
+def journal_findings(findings: List[dict]) -> int:
+    """Emit each (fresh) finding as a ``bench_regression`` journal event
+    plus a ``bench_regressions_total{kind}`` counter, so the sentinel's
+    verdicts flow through the same alert/journal plane the runtime uses
+    (an SLO rule over ``bench_regressions_total == 0`` pages on them).
+    Degrades silently when paddle_tpu is not importable -- this tool must
+    stay runnable standalone in CI."""
+    if not findings:
+        return 0
+    try:
+        from paddle_tpu.observability import journal as _journal
+        from paddle_tpu.observability.metrics import REGISTRY as _OBS
+    except Exception:
+        return 0
+    for f in findings:
+        _journal.emit({"event": "bench_regression", "kind": f["kind"],
+                       "family": f["family"], "metric": f["metric"],
+                       "pct": f["pct"], "detail": f["detail"]})
+        _OBS.counter("bench_regressions_total",
+                     "bench trajectory regressions flagged by the "
+                     "sentinel, by kind", kind=f["kind"]).inc()
+    return len(findings)
+
+
 def compare_files(paths: List[str],
                   threshold_pct: float = DEFAULT_THRESHOLD_PCT,
                   baseline: Optional[str] = None) -> dict:
-    """The whole pipeline as one call (used by obs_report and ci_lint)."""
+    """The whole pipeline as one call (used by obs_report and ci_lint).
+    Fresh (unsuppressed) findings are also journaled as
+    ``bench_regression`` events -- see :func:`journal_findings`."""
     series = build_trajectories(paths)
     findings = find_regressions(series, threshold_pct)
     fresh, suppressed = suppress(findings, load_baseline(baseline)
                                  if baseline else [])
+    journal_findings(fresh)
     return {"series": series, "findings": findings, "fresh": fresh,
             "suppressed": suppressed}
 

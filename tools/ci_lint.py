@@ -22,6 +22,12 @@ Two checks, one command, one exit code:
    ``tools/bench_baseline.jsonl`` fails the gate (the r06 fused-transformer
    finding is acknowledged there; a *new* one is not).
 
+4. **SLO rules**: every checked-in SLO rule file (``examples/*slo*.json``,
+   ``*.slo.json``) validates against the
+   :mod:`paddle_tpu.observability.slo` schema AND the source-scanned
+   metric-family catalogue -- a typo'd family name or a malformed burn
+   window fails the gate before it can silently watch nothing at runtime.
+
     python tools/ci_lint.py                          # all checks
     python tools/ci_lint.py --baseline ci_lint.keys  # gate on new findings
     python tools/ci_lint.py --selftest               # pinned by the tests
@@ -258,6 +264,41 @@ def lint_bench() -> List[str]:
     return [f["detail"] for f in res["fresh"]]
 
 
+# ------------------------------------------------------------- SLO rules --
+
+SLO_RULES_GLOBS = (os.path.join(REPO, "examples", "*slo*.json"),
+                   os.path.join(REPO, "*.slo.json"))
+
+
+def slo_rule_files() -> List[str]:
+    import glob
+    paths: List[str] = []
+    for pat in SLO_RULES_GLOBS:
+        paths.extend(sorted(glob.glob(pat)))
+    return paths
+
+
+def lint_slo(paths: List[str] = None) -> List[str]:
+    """Schema problems across every checked-in SLO rules file (empty =
+    gate green).  A typo'd metric family fails here -- the catalogue is
+    scanned from the source tree, so a rule can only watch a family some
+    module actually registers."""
+    from paddle_tpu.observability import slo
+    known = slo.known_metric_families()
+    findings: List[str] = []
+    for path in (slo_rule_files() if paths is None else paths):
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            findings.append(f"{rel}: unreadable: {e}")
+            continue
+        findings.extend(f"{rel}: {p}"
+                        for p in slo.validate_rules(doc, known=known))
+    return findings
+
+
 # ----------------------------------------------------------------- driver --
 
 def _load_baseline(path: str) -> Dict[str, set]:
@@ -345,6 +386,26 @@ def selftest() -> int:
         if fresh:
             failures.append("bench baseline does not suppress current "
                             "findings:\n  " + "\n  ".join(fresh))
+    # 6. SLO rules gate: the checked-in files validate clean, and a
+    # planted file with a typo'd family + malformed window is caught
+    clean = lint_slo()
+    if clean:
+        failures.append("checked-in SLO rule files have problems:\n  "
+                        + "\n  ".join(clean))
+    with tempfile.TemporaryDirectory() as td:
+        bad = os.path.join(td, "bad_slo.json")
+        with open(bad, "w") as f:
+            json.dump({"format": "paddle_tpu_slo_rules_v1", "rules": [
+                {"id": "typo", "metric": "goodput_fractoin",
+                 "objective": ">= 0.85"},
+                {"id": "badwin", "metric": "goodput_fraction",
+                 "objective": ">= 0.85",
+                 "windows": [{"long_s": 60, "short_s": 300, "burn": 2}]},
+            ]}, f)
+        probs = lint_slo([bad])
+        if not any("goodput_fractoin" in p for p in probs) or \
+                not any("short_s must be < long_s" in p for p in probs):
+            failures.append(f"planted bad SLO rules not caught: {probs}")
     if failures:
         print("ci_lint selftest: FAILED")
         for msg in failures:
@@ -373,6 +434,8 @@ def main(argv=None) -> int:
                     help="run only the unused-import sweep")
     ap.add_argument("--skip-bench", action="store_true",
                     help="skip the bench trajectory check")
+    ap.add_argument("--skip-slo", action="store_true",
+                    help="skip the SLO rule file validation")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
@@ -428,6 +491,15 @@ def main(argv=None) -> int:
             rc = 1
         else:
             print("bench trajectory: clean")
+    if not args.skip_slo:
+        probs = lint_slo()
+        for p in probs:
+            print(f"slo: {p}")
+        if probs:
+            print(f"slo rules: {len(probs)} problem(s)")
+            rc = 1
+        else:
+            print(f"slo rules: clean ({len(slo_rule_files())} file(s))")
     return rc
 
 

@@ -695,6 +695,68 @@ def render_fleet(events: Optional[List[dict]]) -> str:
     return "\n".join(lines)
 
 
+# ----------------------------------------------------- alerts/postmortem --
+
+def render_alerts(events: Optional[List[dict]],
+                  snapshot: Optional[dict] = None) -> str:
+    """SLO alert firings/resolutions (observability/slo.py + alerts.py)
+    and post-mortem black-box bundles (observability/blackbox.py)."""
+    lines = ["== Alerts & post-mortems =="]
+    events = events or []
+    armed = [e for e in events if e.get("event") == "slo_armed"]
+    alerts = [e for e in events if e.get("event") == "alert"]
+    bundles = [e for e in events if e.get("event") == "postmortem"]
+    if not armed and not alerts and not bundles:
+        lines.append("no alert/postmortem events (arm PADDLE_TPU_OBS_SLO="
+                     "rules.json and PADDLE_TPU_OBS_BLACKBOX=1)")
+        return "\n".join(lines)
+    if armed:
+        last = armed[-1]
+        rules = [str(r) for r in (last.get("rules") or [])]
+        shown = ", ".join(rules[:6]) + (", ..." if len(rules) > 6 else "")
+        lines.append(f"SLO engine armed: {len(rules)} rule(s) [{shown}], "
+                     f"interval {last.get('interval_s')}s, poller "
+                     f"{'on' if last.get('poller') else 'off'}")
+
+    def _key(e):
+        return (e.get("rule"), e.get("window"),
+                tuple(sorted((e.get("labels") or {}).items())))
+
+    if alerts:
+        fired = [e for e in alerts if e.get("state") == "firing"]
+        resolved = [e for e in alerts if e.get("state") == "resolved"]
+        still = {}
+        for e in alerts:
+            if e.get("state") == "firing":
+                still[_key(e)] = e
+            elif e.get("state") == "resolved":
+                still.pop(_key(e), None)
+        lines.append(f"{len(fired)} firing(s), {len(resolved)} "
+                     f"resolution(s); {len(still)} still firing")
+        for e in list(still.values())[:10]:
+            lab = ",".join(f"{k}={v}" for k, v
+                           in sorted((e.get("labels") or {}).items()))
+            name = f"{e.get('rule')}{{{lab}}}" if lab else str(e.get("rule"))
+            lines.append(f"  FIRING [{e.get('severity')}] {name} "
+                         f"[{e.get('window')}]: observed "
+                         f"{e.get('observed')} vs {e.get('objective')} "
+                         f"(burn {e.get('burn')})")
+        for e in resolved[-5:]:
+            lines.append(f"  resolved {e.get('rule')} [{e.get('window')}]")
+    n_total = _counter_total(snapshot, "alerts_total")
+    if n_total is not None:
+        n_active = _counter_total(snapshot, "alerts_active") or 0.0
+        lines.append(f"alert firings counted: {int(n_total)}; "
+                     f"active now: {int(n_active)}")
+    if bundles:
+        lines.append(f"{len(bundles)} post-mortem bundle(s):")
+        for e in bundles[-5:]:
+            lines.append(f"  BUNDLE [{e.get('reason')}] -> "
+                         f"{e.get('path')}")
+        lines.append("triage with: python tools/postmortem.py <bundle dir>")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- timeline --
 
 def render_timeline(trace_events: List[dict]) -> str:
@@ -798,6 +860,7 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_checkpoint(events, snapshot))
         parts.append(render_serving(events, snapshot))
         parts.append(render_ingestion(events, snapshot))
+        parts.append(render_alerts(events, snapshot))
     if bench_summary is not None or snapshot is not None or events:
         parts.append(render_attribution(events, snapshot, bench_summary))
     if goodput:
@@ -886,6 +949,11 @@ def selftest() -> int:
     reg.gauge("stream_buffer_depth").set(7)
     for v in (0.003, 0.005, 0.011):
         reg.histogram("sample_age_seconds").observe(v)
+    # alerts & post-mortem sources (observability/slo.py + blackbox.py)
+    reg.counter("alerts_total", rule="training-goodput",
+                severity="page").inc(2)
+    reg.gauge("alerts_active").set(1)
+    reg.counter("postmortem_bundles_total", reason="retries_exhausted").inc()
 
     events = [
         {"event": "run", "program": 1, "version": 0, "cache": "miss",
@@ -990,6 +1058,28 @@ def selftest() -> int:
          "ts": 9.965},
         {"event": "stream_epoch", "batches": 12, "records": 36,
          "dead_letters": 3, "sources": {"clicks": 2048}, "ts": 9.966},
+        # alerts & post-mortem section (ISSUE 17)
+        {"event": "slo_armed", "rules": ["training-goodput",
+                                        "serving-latency-p99"],
+         "interval_s": 5.0, "poller": True, "ts": 9.97},
+        {"event": "alert", "state": "firing", "rule": "training-goodput",
+         "severity": "page", "window": "300s/60s", "labels": {},
+         "observed": 0.61, "objective": "goodput_fraction >= 0.85",
+         "burn": 39.0, "ts": 9.971},
+        {"event": "alert", "state": "firing", "rule": "serving-latency-p99",
+         "severity": "page", "window": "300s/60s",
+         "labels": {"tenant": "a"}, "observed": 0.052,
+         "objective": "serving_request_seconds{tenant=a} p99 <= 0.025",
+         "burn": 18.0, "ts": 9.972},
+        {"event": "alert", "state": "resolved",
+         "rule": "serving-latency-p99", "severity": "page",
+         "window": "300s/60s", "labels": {"tenant": "a"},
+         "observed": 0.009,
+         "objective": "serving_request_seconds{tenant=a} p99 <= 0.025",
+         "burn": 0.0, "ts": 9.973},
+        {"event": "postmortem", "reason": "retries_exhausted",
+         "path": "postmortems/postmortem-20260806T000000Z-p1/bundle.json",
+         "ts": 9.974},
     ]
 
     # a synthetic flight-recorder trace through the real exporter
@@ -1105,6 +1195,19 @@ def selftest() -> int:
                      "['part-00007.txt']",
                      "sample freshness: n=3",
                      "buffer depth now: 7",
+                     # alerts & post-mortem section (ISSUE 17)
+                     "== Alerts & post-mortems ==",
+                     "SLO engine armed: 2 rule(s) [training-goodput, "
+                     "serving-latency-p99], interval 5.0s, poller on",
+                     "2 firing(s), 1 resolution(s); 1 still firing",
+                     "FIRING [page] training-goodput [300s/60s]: observed "
+                     "0.61 vs goodput_fraction >= 0.85 (burn 39.0)",
+                     "resolved serving-latency-p99 [300s/60s]",
+                     "alert firings counted: 2; active now: 1",
+                     "1 post-mortem bundle(s):",
+                     "BUNDLE [retries_exhausted] -> postmortems/"
+                     "postmortem-20260806T000000Z-p1/bundle.json",
+                     "triage with: python tools/postmortem.py",
                      # goodput section (wall-clock ledger)
                      "== Goodput ==", "-> goodput",
                      "dispatch + fetch_sync", "lost compile",
@@ -1147,6 +1250,7 @@ def selftest() -> int:
             render_attribution([], {"families": []})
         assert "no goodput window" in render_goodput([], None)
         assert "single-rank" in render_fleet([])
+        assert "no alert/postmortem events" in render_alerts([])
     print("obs_report selftest: OK")
     return 0
 
