@@ -28,9 +28,11 @@ Passes (pass_base registry, the ir::Pass analog): ``wellformed``
 ``dataflow`` (dead ops, WAW hazards, fetch reachability), ``typecheck``
 (shape/dtype propagation vs declarations), ``recompile`` (compile-cache
 churn risks), ``distributed`` (collective/mesh consistency, SPMD deadlock
-shapes, sharding legality vs a DistributedStrategy), and the opt-in
+shapes, sharding legality vs a DistributedStrategy), the opt-in
 ``memplan`` (static liveness-based peak-memory planner, engaged by
-``mem_budget=`` / ``--mem-budget`` or by naming the pass).
+``mem_budget=`` / ``--mem-budget`` or by naming the pass), and the opt-in
+``shardplan`` (static auto-sharding planner, engaged by ``auto_shard=True``
+/ ``--auto-shard``: PT04x-pruned, cost-priced shard-plan search, PT07x).
 """
 from __future__ import annotations
 
@@ -42,6 +44,7 @@ from . import distributed  # noqa: F401
 from . import layout_churn  # noqa: F401
 from . import memplan  # noqa: F401
 from . import recompile  # noqa: F401
+from . import shardplan  # noqa: F401
 from . import typecheck  # noqa: F401
 from . import wellformed  # noqa: F401
 from .diagnostics import (CODES, Diagnostic, Severity,  # noqa: F401
@@ -53,7 +56,9 @@ from .memplan import (MemEstimate, estimate_program_memory,  # noqa: F401
                       format_bytes, infer_batch, parse_bytes)
 from .pass_base import (AnalysisPass, PassContext,  # noqa: F401
                         default_passes, get_pass, register_pass,
-                        registered_passes, run_passes)
+                        registered_passes, run_passes, split_strategy)
+from .shardplan import (SearchResult, ShardPlan,  # noqa: F401
+                        search_plans)
 
 
 class VerificationError(RuntimeError):
@@ -71,7 +76,9 @@ def verify(program: Program,
            passes: Optional[Sequence[str]] = None,
            strategy=None, mem_budget: Optional[int] = None,
            batch: Optional[int] = None,
-           fuse_k: Optional[int] = None) -> List[Diagnostic]:
+           fuse_k: Optional[int] = None,
+           auto_shard: bool = False,
+           top_k: Optional[int] = None) -> List[Diagnostic]:
     """Run the analysis pipeline over ``program``; return sorted findings.
 
     ``feed_names``/``fetch_names`` sharpen the analysis when the run intent
@@ -93,7 +100,26 @@ def verify(program: Program,
     its K): the PT03x recompile lint then reasons about the fused feed
     signature -- per-step shapes plus a K key component -- and flags the
     compile-churn modes fusion adds (PT034).
+
+    ``auto_shard=True`` engages the static auto-sharding planner (PT07x):
+    it enumerates PT04x-legal per-tensor shard assignments over the
+    strategy's mesh, prices them with the comm wire-byte model and the
+    PT05x peak estimate, and reports the chosen plan (PT070), a budget
+    infeasibility (PT071), or a near-tie measurement advisory (PT072).
+    Requires a ``strategy`` with a concrete ``mesh_shape``; ``top_k``
+    bounds the ranked plans kept (default 3).
     """
+    if auto_shard:
+        ds, _ = split_strategy(strategy)
+        if ds is None or not getattr(ds, "mesh_shape", None):
+            raise ValueError(
+                "auto_shard=True needs a strategy with a concrete "
+                "mesh_shape: the planner prices candidates against real "
+                "axis sizes (pass DistributedStrategy(mesh_shape="
+                "{'dp': ..., 'mp': ...}))")
+        passes = list(passes) if passes is not None else default_passes()
+        if "shardplan" not in passes:
+            passes = passes + ["shardplan"]
     # supplying a budget or a strategy means the caller wants that check's
     # verdict: engage the owning pass even under an explicit --passes
     # subset (a CI gate narrowing passes must not silently lose the PT051
@@ -110,7 +136,8 @@ def verify(program: Program,
                                        fetch_names=fetch_names,
                                        strategy=strategy,
                                        mem_budget=mem_budget, batch=batch,
-                                       fuse_k=fuse_k))
+                                       fuse_k=fuse_k, auto_shard=auto_shard,
+                                       top_k=top_k))
 
 
 def verify_or_raise(program: Program,

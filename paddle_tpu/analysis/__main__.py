@@ -3,6 +3,8 @@
     python -m paddle_tpu.analysis prog.json [--fetch loss] [--feed img]
     python -m paddle_tpu.analysis prog.json --strategy strat.json \
         --mem-budget 8G --batch 256          # distributed + memory checks
+    python -m paddle_tpu.analysis prog.json --strategy strat.json \
+        --auto-shard [--top-k 3]             # auto-sharding planner (PT07x)
     python -m paddle_tpu.analysis prog.json --baseline accepted.keys \
         [--update-baseline]                  # CI: gate on NEW findings only
     python -m paddle_tpu.analysis --codes        # diagnostic-code table
@@ -66,6 +68,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--batch", default=None, type=int,
                     help="batch size resolving dynamic (-1) dims for the "
                          "memory planner and divisibility checks")
+    ap.add_argument("--auto-shard", action="store_true",
+                    help="run the static auto-sharding planner (PT07x): "
+                         "search PT04x-legal shard plans over the "
+                         "--strategy mesh, price them (comm wire bytes + "
+                         "peak memory), report the chosen plan (PT070) or "
+                         "a budget infeasibility (PT071); needs --strategy "
+                         "with a concrete mesh_shape")
+    ap.add_argument("--top-k", default=None, type=int, metavar="K",
+                    help="ranked plans the auto-shard search keeps "
+                         "(default 3)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="suppression file of accepted Diagnostic keys: "
                          "findings matching an entry are dropped before "
@@ -227,6 +239,24 @@ def _selftest() -> int:
                                    mem_budget=1 << 30),
            has=("PT050",), lacks=("PT051", "PT052"))
 
+    # auto-shard planner: a shardable matmul finds a plan (PT070); an
+    # impossible budget reports infeasibility instead (PT071)
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", (8, 64), "float32", is_data=True)
+    b.create_parameter("w", (64, 128), "float32")
+    b.append_op("matmul", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["y"]})
+    strat = strategy_from_dict({"mesh_shape": {"dp": 4, "mp": 2}})
+    expect("auto-shard plan",
+           verify(p, feed_names=["x"], fetch_names=["y"], strategy=strat,
+                  auto_shard=True),
+           has=("PT070",), lacks=("PT071",), no_errors=True)
+    expect("auto-shard infeasible",
+           verify(p, feed_names=["x"], fetch_names=["y"], strategy=strat,
+                  auto_shard=True, mem_budget=16),
+           has=("PT071",), lacks=("PT070",))
+
     # baseline round trip: accepted findings suppress byte-stably
     import tempfile
     p = Program()
@@ -289,8 +319,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         diags = verify(program, feed_names=args.feed,
                        fetch_names=args.fetch, passes=passes,
                        strategy=strategy, mem_budget=args.mem_budget,
-                       batch=args.batch)
-    except KeyError as e:
+                       batch=args.batch, auto_shard=args.auto_shard,
+                       top_k=args.top_k)
+    except (KeyError, ValueError) as e:
         print(f"error: {e}")
         return 2
     if args.update_baseline:

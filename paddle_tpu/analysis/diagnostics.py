@@ -124,6 +124,18 @@ CODES: Dict[str, tuple] = {
                              "per step in the compiled program; consider "
                              "the conv2d.layout autotune or reordering "
                              "the producer"),
+    # -- static auto-sharding planner (shardplan.py) -----------------------
+    "PT070": (Severity.INFO, "auto-shard: the chosen shard plan -- per-"
+                             "tensor spec assignment with the priced comm "
+                             "and memory breakdown (PT04x-legal by "
+                             "construction, PT05x-peak-checked)"),
+    "PT071": (Severity.WARN, "auto-shard: no legal shard plan fits the "
+                             "memory budget on this mesh; the most memory-"
+                             "frugal plan's peak quantifies the gap"),
+    "PT072": (Severity.INFO, "auto-shard: the top plans price within the "
+                             "near-tie threshold -- the static cost model "
+                             "cannot separate them; set auto_shard="
+                             "'measure' to decide on the live workload"),
 }
 
 

@@ -455,6 +455,14 @@ class DistributedPass(AnalysisPass):
                         dp) for b, _, _ in gathered)
                     priced += (f"; compressed ({mode}) the same plan "
                                f"ships ~{comp} B/device/step")
+                if getattr(ctx, "auto_shard", False) or \
+                        getattr(ds, "auto_shard", "off") != "off":
+                    # the armed planner can price a cheaper assignment
+                    from .shardplan import regather_alternative
+                    alt = regather_alternative(
+                        ctx, [n for _, n, _ in gathered], dp)
+                    if alt is not None:
+                        priced += "; " + alt
                 diags.append(Diagnostic(
                     "PT046", f"ReduceStrategy.Reduce + reduce_params "
                              f"shards {len(gathered)} parameter(s) over dp "

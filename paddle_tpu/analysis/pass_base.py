@@ -65,7 +65,9 @@ class PassContext:
                  fetch_names: Optional[Sequence[str]] = None,
                  strategy=None, mem_budget: Optional[int] = None,
                  batch: Optional[int] = None,
-                 fuse_k: Optional[int] = None):
+                 fuse_k: Optional[int] = None,
+                 auto_shard: bool = False,
+                 top_k: Optional[int] = None):
         self.program = program
         # empty == unknown intent, same as None: an executor run with no
         # fetch_list must not flag the whole program dead (PT010), and
@@ -83,6 +85,11 @@ class PassContext:
         # signature (per-step shapes + a K key component), not the stacked
         # (K, batch, ...) arrays it happens to dispatch
         self.fuse_k = fuse_k
+        # auto-shard intent: arms the shardplan search pass (PT07x) and
+        # upgrades the PT046 re-gather warning with the planner's priced
+        # alternative; top_k bounds the ranked plans it keeps
+        self.auto_shard = bool(auto_shard)
+        self.top_k = top_k
         self._referencing: Optional[Dict[int, List[Tuple[int, int]]]] = None
         self._roots: Optional[Set[str]] = None
 
@@ -174,10 +181,12 @@ def run_passes(program: Program, passes: Optional[Sequence[str]] = None,
                fetch_names: Optional[Sequence[str]] = None,
                strategy=None, mem_budget: Optional[int] = None,
                batch: Optional[int] = None,
-               fuse_k: Optional[int] = None) -> List[Diagnostic]:
+               fuse_k: Optional[int] = None,
+               auto_shard: bool = False,
+               top_k: Optional[int] = None) -> List[Diagnostic]:
     ctx = PassContext(program, feed_names=feed_names, fetch_names=fetch_names,
                       strategy=strategy, mem_budget=mem_budget, batch=batch,
-                      fuse_k=fuse_k)
+                      fuse_k=fuse_k, auto_shard=auto_shard, top_k=top_k)
     diags: List[Diagnostic] = []
     for name in (passes if passes is not None else default_passes()):
         diags.extend(get_pass(name).run(ctx))

@@ -120,18 +120,29 @@ class DistributedStrategy:
       and tensors under ``comm_compress_min_bytes`` short-circuit to the
       uncompressed path; per-tensor on/off above the floor is the
       ``comm.compress`` TunableChoice.
+    auto_shard: 'off'|'static'|'measure' -- the static auto-sharding
+      planner (analysis/shardplan.py). 'off' (default) does zero planner
+      work; 'static' searches PT04x-legal, cost-priced shard plans over
+      ``mesh_shape`` at compile time and splices the top plan's
+      param_rules in; 'measure' hands the top-k plans to the tuning
+      harness (``shardplan.plan`` choice, decisions cached under
+      tuning/cache.py keys). Needs a concrete ``mesh_shape``.
     """
+
+    AUTO_SHARD_MODES = ("off", "static", "measure")
 
     def __init__(self, mesh_shape: Optional[Dict[str, int]] = None,
                  param_rules: Optional[List[Tuple[str, Tuple]]] = None,
                  data_rules: Optional[List[Tuple[str, Tuple]]] = None,
                  data_axis: str = "dp",
-                 comm_compression: str = "off"):
+                 comm_compression: str = "off",
+                 auto_shard: str = "off"):
         self.mesh_shape = dict(mesh_shape or {})
         self.param_rules = list(param_rules or [])
         self.data_rules = list(data_rules or [])
         self.data_axis = data_axis
         self.comm_compression = comm_compression
+        self.auto_shard = auto_shard
         # hard floor in bytes below which a tensor never compresses (the
         # quantize arithmetic costs more than a small message saves)
         from .comm.compress import MIN_COMPRESS_BYTES
@@ -147,6 +158,10 @@ class DistributedStrategy:
                 raise ValueError(
                     f"comm_compression must be one of {MODES}, "
                     f"got {value!r}")
+        if name == "auto_shard" and value not in self.AUTO_SHARD_MODES:
+            raise ValueError(
+                f"auto_shard must be one of {self.AUTO_SHARD_MODES}, "
+                f"got {value!r}")
         if name == "use_hierarchical_allreduce" and value:
             _warn_noop_knob(
                 "DistributedStrategy.use_hierarchical_allreduce",
@@ -164,7 +179,8 @@ class DistributedStrategy:
                 "data_rules": [[p, list(s)] for p, s in self.data_rules],
                 "data_axis": self.data_axis,
                 "comm_compression": self.comm_compression,
-                "comm_compress_min_bytes": self.comm_compress_min_bytes}
+                "comm_compress_min_bytes": self.comm_compress_min_bytes,
+                "auto_shard": self.auto_shard}
 
     @staticmethod
     def from_dict(d: dict) -> "DistributedStrategy":
@@ -181,7 +197,8 @@ class DistributedStrategy:
             param_rules=[(p, spec(s)) for p, s in d.get("param_rules") or []],
             data_rules=[(p, spec(s)) for p, s in d.get("data_rules") or []],
             data_axis=d.get("data_axis", "dp"),
-            comm_compression=d.get("comm_compression", "off"))
+            comm_compression=d.get("comm_compression", "off"),
+            auto_shard=d.get("auto_shard", "off"))
         if "comm_compress_min_bytes" in d:
             ds.comm_compress_min_bytes = int(d["comm_compress_min_bytes"])
         return ds
@@ -265,7 +282,8 @@ class CompiledProgram:
                 ds.data_axis, self.build_strategy.reduce_strategy,
                 getattr(self.build_strategy, "reduce_params", False),
                 getattr(ds, "comm_compression", "off"),
-                getattr(ds, "comm_compress_min_bytes", None))
+                getattr(ds, "comm_compress_min_bytes", None),
+                getattr(ds, "auto_shard", "off"))
 
     @property
     def mesh(self):

@@ -634,6 +634,16 @@ class Executor:
         if compiled_wrapper is not None and compiled_wrapper.dist_strategy:
             ds = compiled_wrapper.dist_strategy
             compiled_wrapper.mesh  # force mesh build (fills default mesh_shape)
+            if getattr(ds, "auto_shard", "off") != "off":
+                # static auto-sharding: resolve once per (program, mesh,
+                # mode, batch) and splice the plan's param_rules into the
+                # live strategy BEFORE the compile key reads its signature.
+                # auto_shard='off' pays exactly this one getattr.
+                from ..analysis import shardplan as _shardplan
+                _shardplan.resolve_auto_shard(
+                    compiled_wrapper, program=program,
+                    feed_names=sorted(feed), fetch_names=fetch_names,
+                    feed_shapes={k: np.shape(v) for k, v in feed.items()})
             pc = jax.process_count()
             for k, v in feed.items():
                 shape = np.shape(v)

@@ -500,9 +500,41 @@ class CommCompress(TunableChoice):
         return None   # measured on the live workload, never isolated
 
 
+# --------------------------------------------------------------------------------------
+# choice point 7: which of the auto-shard planner's top-k plans to run
+# --------------------------------------------------------------------------------------
+
+
+class ShardPlanChoice(TunableChoice):
+    id = "shardplan.plan"
+    doc = ("which of the static auto-shard planner's top-k plans to run "
+           "(DistributedStrategy.auto_shard='measure'): 'top1' is the "
+           "cheapest-priced plan, 'topN' the Nth. The static wire-byte "
+           "model cannot price overlap or XLA's collective fusion, so "
+           "near-ties (PT072) are decided on the live workload; external "
+           "measurements persist via tuning.record_decision(). Keyed by "
+           "the top plan's digest + the mesh, so a program or mesh change "
+           "re-decides.")
+
+    def bucket(self, params):
+        return {"plan": str(params["digest"]),
+                "mesh": str(params["mesh"]),
+                "k": int(params["k"])}
+
+    def candidates(self, params):
+        return [f"top{i}" for i in range(1, int(params["k"]) + 1)]
+
+    def default(self, params):
+        return "top1"  # the statically cheapest plan
+
+    def bench(self, params, candidate):
+        return None   # measured on the live workload, never isolated
+
+
 register_choice(ConvBnBackend())
 register_choice(FlashBackend())
 register_choice(FlashBlockSizes())
 register_choice(ConvLayout())
 register_choice(FuseSteps())
 register_choice(CommCompress())
+register_choice(ShardPlanChoice())

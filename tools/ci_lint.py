@@ -28,6 +28,12 @@ Two checks, one command, one exit code:
    metric-family catalogue -- a typo'd family name or a malformed burn
    window fails the gate before it can silently watch nothing at runtime.
 
+5. **Auto-shard planner**: the static shard-plan search
+   (``paddle_tpu.analysis.shardplan``) must find a legal within-budget
+   plan (PT070, no PT071) for every example program under a dp8 AND a
+   dp4xmp2 mesh -- a planner that stops covering the bundled models is a
+   regression even before any runtime notices.
+
     python tools/ci_lint.py                          # all checks
     python tools/ci_lint.py --baseline ci_lint.keys  # gate on new findings
     python tools/ci_lint.py --selftest               # pinned by the tests
@@ -247,21 +253,56 @@ def lint_imports(roots=("paddle_tpu", "tools")) -> List[str]:
 # -------------------------------------------------------- bench trajectory --
 
 BENCH_ROUND_GLOB = os.path.join(REPO, "BENCH_WORKLOADS_r*.json")
+BENCH_ROUND_GLOBS = (BENCH_ROUND_GLOB,
+                     os.path.join(REPO, "BENCH_AUTOSHARD_r*.json"))
 BENCH_BASELINE = os.path.join(REPO, "tools", "bench_baseline.jsonl")
 
 
 def lint_bench() -> List[str]:
     """Unsuppressed bench-trajectory regressions over the checked-in
-    WORKLOADS rounds (detail strings; empty = gate green)."""
+    WORKLOADS + AUTOSHARD rounds (detail strings; empty = gate green)."""
     import glob
     from tools import bench_compare
-    paths = sorted(glob.glob(BENCH_ROUND_GLOB))
+    paths = sorted(p for pat in BENCH_ROUND_GLOBS
+                   for p in glob.glob(pat))
     if not paths:
         return []
     res = bench_compare.compare_files(
         paths, baseline=BENCH_BASELINE
         if os.path.exists(BENCH_BASELINE) else None)
     return [f["detail"] for f in res["fresh"]]
+
+
+# ------------------------------------------------------ auto-shard planner --
+
+AUTOSHARD_MESHES = (("dp8", {"dp": 8}), ("dp4xmp2", {"dp": 4, "mp": 2}))
+AUTOSHARD_BUDGET = 1 << 30  # 1 GiB/device: every bundled example fits
+
+
+def lint_autoshard() -> List[str]:
+    """The shard-plan search must find a legal within-budget plan (PT070,
+    no PT071/errors) for every example program on every CI mesh (detail
+    strings; empty = gate green)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import analysis
+    findings: List[str] = []
+    for name, build in EXAMPLE_PROGRAMS:
+        main, _, feeds, fetches = build()
+        for mesh_tag, mesh in AUTOSHARD_MESHES:
+            strat = fluid.DistributedStrategy(mesh_shape=dict(mesh))
+            diags = analysis.verify(main, feed_names=feeds,
+                                    fetch_names=fetches, strategy=strat,
+                                    auto_shard=True,
+                                    mem_budget=AUTOSHARD_BUDGET)
+            codes = {d.code for d in diags}
+            tag = f"{name}@{mesh_tag}"
+            if "PT071" in codes:
+                msg = next(d.message for d in diags if d.code == "PT071")
+                findings.append(f"{tag}: no plan fits the CI budget: {msg}")
+            elif "PT070" not in codes:
+                findings.append(f"{tag}: planner emitted no PT070 plan "
+                                f"(codes: {sorted(codes)})")
+    return findings
 
 
 # ------------------------------------------------------------- SLO rules --
@@ -386,7 +427,30 @@ def selftest() -> int:
         if fresh:
             failures.append("bench baseline does not suppress current "
                             "findings:\n  " + "\n  ".join(fresh))
-    # 6. SLO rules gate: the checked-in files validate clean, and a
+    # 6. auto-shard gate: the example programs all plan within the CI
+    # budget, and a planted over-budget model trips PT071 (the detector
+    # works, the repo is clean)
+    asf = lint_autoshard()
+    if asf:
+        failures.append("auto-shard planner findings on example "
+                        "programs:\n  " + "\n  ".join(asf))
+    import paddle_tpu as fluid
+    from paddle_tpu import analysis as _analysis
+    big_main, big_startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), \
+            fluid.program_guard(big_main, big_startup):
+        x = fluid.data("x", [1024], "float32")
+        y = fluid.layers.fc(x, 4096)   # 1024x4096 f32 = 16 MiB weight
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    planted = _analysis.verify(
+        big_main, feed_names=["x"], fetch_names=[loss.name],
+        strategy=fluid.DistributedStrategy(mesh_shape={"dp": 4, "mp": 2}),
+        auto_shard=True, mem_budget=1024)  # 1 KiB: nothing can fit
+    if "PT071" not in {d.code for d in planted}:
+        failures.append("planted over-budget model did not trip PT071: "
+                        f"{sorted({d.code for d in planted})}")
+    # 7. SLO rules gate: the checked-in files validate clean, and a
     # planted file with a typo'd family + malformed window is caught
     clean = lint_slo()
     if clean:
@@ -436,6 +500,8 @@ def main(argv=None) -> int:
                     help="skip the bench trajectory check")
     ap.add_argument("--skip-slo", action="store_true",
                     help="skip the SLO rule file validation")
+    ap.add_argument("--skip-autoshard", action="store_true",
+                    help="skip the auto-shard planner coverage check")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
@@ -500,6 +566,17 @@ def main(argv=None) -> int:
             rc = 1
         else:
             print(f"slo rules: clean ({len(slo_rule_files())} file(s))")
+    if not args.skip_autoshard:
+        asf = lint_autoshard()
+        for f in asf:
+            print(f"autoshard: {f}")
+        if asf:
+            print(f"auto-shard planner: {len(asf)} finding(s)")
+            rc = 1
+        else:
+            print(f"auto-shard planner: clean "
+                  f"({len(EXAMPLE_PROGRAMS)} example programs x "
+                  f"{len(AUTOSHARD_MESHES)} meshes)")
     return rc
 
 

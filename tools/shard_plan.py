@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""shard_plan: search a static auto-sharding plan for a serialized Program.
+
+Thin launcher over ``python -m paddle_tpu.analysis --auto-shard`` (same
+flags, --auto-shard implied) for environments that invoke tools/ scripts
+directly:
+
+    python tools/shard_plan.py prog.json --strategy strat.json
+    python tools/shard_plan.py prog.json --strategy strat.json \
+        --mem-budget 8G --batch 256 --top-k 5 --format json
+
+The strategy JSON needs a concrete ``mesh_shape`` (e.g. ``{"mesh_shape":
+{"dp": 4, "mp": 2}}``); the plan arrives as a PT070 info finding (PT071
+when no legal plan fits --mem-budget, PT072 on a near-tie).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if "--auto-shard" not in argv:
+        argv = argv + ["--auto-shard"]
+    sys.exit(main(argv))
