@@ -67,7 +67,8 @@ class Predictor:
     """AOT-compiled serving session over a save_inference_model directory."""
 
     def __init__(self, model_dir: str, model_filename=None,
-                 params_filename=None, dtype: Optional[str] = None):
+                 params_filename=None, dtype: Optional[str] = None,
+                 sparse_tables: Optional[Dict[str, object]] = None):
         import jax
         from . import io
         self._scope = Scope()
@@ -78,6 +79,37 @@ class Predictor:
         self.program: Program = prog
         self.feed_names: List[str] = list(feeds)
         self.fetch_names: List[str] = list(fetches)
+        # sparse-lookup feed path (online serving): host_lookup_table pulls
+        # are hoisted OUT of the compiled program -- the minibatch rows
+        # enter as a runtime feed gathered from a TableReplica, so a delta
+        # publish updates the replica array and needs NO recompile (the
+        # executable signature never changes)
+        self._pulls: List[tuple] = []
+        self._sparse_tables: Dict[str, object] = {}
+        if sparse_tables:
+            from .ops.host_table import hoist_host_pulls
+            prog2, pulls, _pushes = hoist_host_pulls(self.program)
+            if not pulls:
+                raise ValueError(
+                    "sparse_tables given but the program has no hoistable "
+                    "host_lookup_table pull (feed-fed ids, non-sharded)")
+            have = {t for t, _, _ in pulls}
+            missing = sorted(have - set(sparse_tables))
+            if missing:
+                raise ValueError(
+                    f"program pulls host tables {missing} with no replica "
+                    f"in sparse_tables {sorted(sparse_tables)}")
+            bad_ids = [i for _, i, _ in pulls if i not in self.feed_names]
+            if bad_ids:
+                raise ValueError(
+                    f"hoisted pull ids {bad_ids} are not model feeds "
+                    f"{self.feed_names}")
+            self.program = prog2
+            self._pulls = pulls
+            self._sparse_tables = dict(sparse_tables)
+        #: executable feed order: model feeds + hoisted sparse-row feeds
+        self._exe_feeds: List[str] = (self.feed_names +
+                                      [out for _, _, out in self._pulls])
         self._dtype = _norm_dtype(dtype)
         # pin parameters on device once (the C++ predictor's pinned
         # buffers); weights read only inside control-flow sub-blocks count
@@ -116,8 +148,40 @@ class Predictor:
         reference flips atomically: a ``run()`` already past its state
         lookup finishes on the old weights, the next call sees the new --
         exactly the between-batches rotation the serving pool needs.
-        ``validate_only=True`` checks compatibility without swapping."""
+        ``validate_only=True`` checks compatibility without swapping.
+
+        PARTIAL (sparse) swap: a key ``"sparse:<table>"`` carries a
+        ``host_table_delta_v1`` doc for one of this predictor's sparse
+        replicas instead of a dense array.  Sparse entries are validated
+        in full (structure, crc, shape, version continuity) against the
+        replica; a state dict of only sparse entries skips the dense
+        missing-parameter check entirely -- that is what
+        ``PredictorPool.apply_delta`` runs through ``validate_only=True``
+        before any live predictor sees the delta."""
         import jax
+        from .online.delta import split_sparse_state
+        dense, sparse = split_sparse_state(new_state)
+        for tname in sparse:
+            if tname not in self._sparse_tables:
+                raise ValueError(
+                    f"swap_state got a sparse delta for table {tname!r} "
+                    f"but this predictor serves "
+                    f"{sorted(self._sparse_tables) or 'no sparse tables'}")
+        # sparse validation first: every check the commit would make, with
+        # nothing mutated (DeltaError/DeltaCorrupt propagate typed)
+        for tname, d in sparse.items():
+            self._sparse_tables[tname].apply(d, validate_only=True)
+        new_state = dense
+        if not dense and sparse:
+            # sparse-only partial swap: no dense params to check or pin
+            if validate_only:
+                return
+            self._commit_sparse(sparse)
+            with self._lock:
+                self.model_version = (int(model_version)
+                                      if model_version is not None
+                                      else self.model_version + 1)
+            return
         missing = [n for n in self._state if n not in new_state]
         if missing:
             raise ValueError(
@@ -140,6 +204,8 @@ class Predictor:
             return
         pinned = {n: jax.device_put(np.asarray(new_state[n]))
                   for n in self._state}
+        if sparse:
+            self._commit_sparse(sparse)
         with self._lock:
             self._state = pinned
             # derived per-dtype cast copies rebuild lazily off the new state
@@ -148,6 +214,17 @@ class Predictor:
                 self.model_version = int(model_version)
             else:
                 self.model_version += 1
+
+    def _commit_sparse(self, sparse: Dict[str, dict]) -> None:
+        """Commit validated sparse deltas onto the attached replicas.
+        Replicas are SHARED across a pool's predictors, so a delta a
+        sibling's rotation already applied lands as a stale no-op."""
+        from .online.delta import DeltaStale
+        for tname, d in sparse.items():
+            try:
+                self._sparse_tables[tname].apply(d)
+            except DeltaStale:
+                pass
 
     # -- serving dtype -----------------------------------------------------------------
     def _state_for(self, dtype: Optional[str]) -> Dict[str, object]:
@@ -197,7 +274,7 @@ class Predictor:
 
         sig = (dtype,) + tuple(
             (k, tuple(np.shape(feed[k])),
-             str(np.asarray(feed[k]).dtype)) for k in self.feed_names)
+             str(np.asarray(feed[k]).dtype)) for k in self._exe_feeds)
         exe = self._compiled.get(sig)
         if exe is not None:
             _count("hit")
@@ -224,7 +301,7 @@ class Predictor:
             args = (state,
                     {k: jax.ShapeDtypeStruct(np.shape(feed[k]),
                                              np.asarray(feed[k]).dtype)
-                     for k in self.feed_names})
+                     for k in self._exe_feeds})
             exe = jax.jit(fwd).lower(*args).compile()   # AOT: no retrace
             self._compiled[sig] = exe
             # IR->HLO attribution for the serving path: /metrics gains
@@ -269,8 +346,15 @@ class Predictor:
         t0 = time.perf_counter()
         dt_serve = _norm_dtype(dtype) if dtype is not None else self._dtype
         with _timeline.phase("feed_prep", cat="predictor"):
-            feed = self._cast_feed(
-                {k: np.asarray(inputs[k]) for k in self.feed_names}, dt_serve)
+            feed = {k: np.asarray(inputs[k]) for k in self.feed_names}
+            for tname, ids_name, out_name in self._pulls:
+                # the serve-time pull: gather the minibatch rows from the
+                # serving replica (lock-free against the publish flip)
+                ids = feed[ids_name]
+                if ids.ndim > 1 and ids.shape[-1] == 1:
+                    ids = ids[..., 0]   # lookup_table squeeze parity
+                feed[out_name] = self._sparse_tables[tname].gather(ids)
+            feed = self._cast_feed(feed, dt_serve)
         exe, cold = self._executable(feed, dt_serve)
         with _timeline.phase("dispatch", cat="predictor"):
             outs = exe(self._state_for(dt_serve), feed)

@@ -1,0 +1,38 @@
+"""Online learning: train on the stream, serve the update seconds later.
+
+The layer between the data plane (``StreamingDataset`` watermarks), the
+host embedding tables (``ops/host_table.py``) and the serving tier
+(``serving/pool.py``) -- the TPU-native analog of the reference stack's
+async parameter-server online recsys loop:
+
+- :mod:`~paddle_tpu.online.delta` -- the ``host_table_delta_v1`` wire
+  format (changed rows + per-chunk crc32, optionally int8/bf16-encoded
+  via ``comm/compress``) and :class:`TableReplica`, the serving-side copy
+  the ``Predictor`` sparse-lookup feed path gathers from;
+- :mod:`~paddle_tpu.online.publisher` -- :class:`OnlinePublisher`, the
+  cadence-driven export->verify->apply driver riding
+  ``StepGuardian.train_from_dataset(step_cb=...)``.
+
+Deliberately NOT imported by ``paddle_tpu/__init__.py``: a process that
+never publishes pays nothing -- the table push hot path stays a single
+attribute read until ``arm_publisher()`` (guard-tested).
+
+    from paddle_tpu.online import OnlinePublisher
+    pool = PredictorPool(model_dir, sparse_tables={"emb": table})
+    pub = OnlinePublisher(table, pool, every_steps=50, encoding="int8",
+                          dataset=ds)
+    guardian.train_from_dataset(dataset=ds, fetch_list=[loss],
+                                step_cb=pub.step_cb)
+"""
+from .delta import (DeltaCorrupt, DeltaError, DeltaStale,
+                    SPARSE_STATE_PREFIX, TableReplica, delta_nbytes,
+                    export_table_delta, sparse_state_key,
+                    split_sparse_state, verify_delta, warm_codec)
+from .publisher import OnlinePublisher, PublishError
+
+__all__ = [
+    "DeltaCorrupt", "DeltaError", "DeltaStale", "OnlinePublisher",
+    "PublishError", "SPARSE_STATE_PREFIX", "TableReplica", "delta_nbytes",
+    "export_table_delta", "sparse_state_key", "split_sparse_state",
+    "verify_delta", "warm_codec",
+]

@@ -1,0 +1,355 @@
+"""Host-table delta wire format and the serving-side replica it feeds.
+
+The online-learning loop ships *changed rows*, not tables: the trainer's
+:class:`~paddle_tpu.ops.host_table.HostTable` tracks dirty rows per
+monotone table version (``arm_publisher``), :func:`export_table_delta`
+snapshots the rows changed since a version under the apply lock, and the
+serving side holds a :class:`TableReplica` -- an immutable-array copy the
+``Predictor`` sparse-lookup feed path gathers from -- advanced by
+:meth:`TableReplica.apply` with the same verify-then-commit discipline as
+a full state swap.
+
+Wire format (``host_table_delta_v1``, an in-process dict -- the transport
+is the caller's problem)::
+
+    {"format": "host_table_delta_v1", "table": str,
+     "vocab_size": int, "dim": int,
+     "since_version": int, "version": int, "full": bool,
+     "encoding": "off"|"bf16"|"int8", "watermark": <stream watermark|None>,
+     "rows_total": int,
+     "chunks": [{"ids": int64[n], "rows": <payload [n, dim]>,
+                 "scale": float|None, "crc32": int}, ...]}
+
+Row payloads optionally ride the EQuARX codecs from
+:mod:`paddle_tpu.comm.compress` (arXiv:2506.17615): ``bf16`` halves the
+on-wire bytes deterministically, ``int8`` quarters them with a per-chunk
+symmetric scale.  Every chunk carries a crc32 over ids+payload+scale so a
+torn or bit-flipped delta is *rejected typed* (:class:`DeltaCorrupt`) on
+the apply side with the old rows still serving -- the partial-swap analog
+of the checkpoint restore crc check.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import journal as _journal
+
+#: delta doc format tag (bump on incompatible layout changes)
+DELTA_FORMAT = "host_table_delta_v1"
+
+#: row-payload encodings; mirrors comm.compress.MODES
+ENCODINGS = ("off", "bf16", "int8")
+
+#: key prefix marking a sparse (delta) entry inside a swap_state dict:
+#: ``{"sparse:<table>": <delta doc>}`` -- the dense keys keep their plain
+#: parameter names, so one state dict can carry both
+SPARSE_STATE_PREFIX = "sparse:"
+
+
+class DeltaError(RuntimeError):
+    """A delta doc that cannot be applied (wrong table/shape, a version
+    gap, a structural defect).  The replica is untouched."""
+
+
+class DeltaCorrupt(DeltaError):
+    """A torn or bit-flipped delta: a chunk failed its crc32 or shape
+    check.  The replica keeps serving the old version."""
+
+
+class DeltaStale(DeltaError):
+    """The delta's target version is not ahead of the replica (already
+    applied, or an out-of-order publish)."""
+
+
+def sparse_state_key(table_name: str) -> str:
+    return SPARSE_STATE_PREFIX + table_name
+
+
+def split_sparse_state(state: dict) -> Tuple[dict, dict]:
+    """Partition a swap_state dict into (dense params, {table: delta})."""
+    dense: Dict[str, object] = {}
+    sparse: Dict[str, object] = {}
+    for k, v in (state or {}).items():
+        if isinstance(k, str) and k.startswith(SPARSE_STATE_PREFIX):
+            sparse[k[len(SPARSE_STATE_PREFIX):]] = v
+        else:
+            dense[k] = v
+    return dense, sparse
+
+
+# -- codecs -----------------------------------------------------------------
+
+def _codec_bucket(n: int) -> int:
+    """Pow2 row bucket the int8 codec computes at: jax compiles per
+    shape, and delta chunks arrive with arbitrary row counts -- padding
+    the codec input (zero rows cannot move the max-abs scale) bounds the
+    compile cache to log2(chunk_rows) shapes instead of one per publish."""
+    return 1 << (max(1, int(n)) - 1).bit_length()
+
+
+def _encode_rows(rows: np.ndarray, encoding: str):
+    """float32 rows -> (payload, scale|None) under ``encoding``."""
+    if encoding == "off":
+        return np.ascontiguousarray(rows, np.float32), None
+    if encoding == "bf16":
+        import ml_dtypes
+        return np.ascontiguousarray(rows).astype(ml_dtypes.bfloat16), None
+    if encoding == "int8":
+        import jax.numpy as jnp
+        from ..comm import compress
+        n = len(rows)
+        padded = np.zeros((_codec_bucket(n), rows.shape[1]), np.float32)
+        padded[:n] = rows
+        q, scale = compress.quantize_int8(jnp.asarray(padded))
+        return np.array(np.asarray(q)[:n]), float(np.asarray(scale))
+    raise ValueError(f"delta encoding must be one of {ENCODINGS}, "
+                     f"got {encoding!r}")
+
+
+def _decode_rows(payload: np.ndarray, scale, encoding: str) -> np.ndarray:
+    """(payload, scale) -> float32 rows."""
+    if encoding == "off":
+        return np.asarray(payload, np.float32)
+    if encoding == "bf16":
+        return np.asarray(payload).astype(np.float32)
+    if encoding == "int8":
+        import jax.numpy as jnp
+        from ..comm import compress
+        payload = np.asarray(payload)
+        n = len(payload)
+        padded = np.zeros((_codec_bucket(n), payload.shape[1]), np.int8)
+        padded[:n] = payload
+        return np.array(np.asarray(compress.dequantize_int8(
+            jnp.asarray(padded), jnp.float32(scale)))[:n])
+    raise ValueError(f"delta encoding must be one of {ENCODINGS}, "
+                     f"got {encoding!r}")
+
+
+def warm_codec(encoding: str, dim: int, rows: int = 1) -> None:
+    """Pre-trace the encode/decode path for the pow2 bucket covering
+    ``rows`` x ``dim`` chunks, so the FIRST publish doesn't pay the
+    codec's one-time per-shape compile inside its click-to-model window.
+    No-op for ``off``."""
+    if encoding == "off":
+        return
+    z = np.zeros((max(1, int(rows)), int(dim)), np.float32)
+    _decode_rows(*_encode_rows(z, encoding), encoding=encoding)
+
+
+def chunk_crc(ids: np.ndarray, payload: np.ndarray, scale) -> int:
+    """crc32 over a chunk's ids + row payload (+ scale) bytes."""
+    c = zlib.crc32(np.ascontiguousarray(ids).tobytes())
+    c = zlib.crc32(np.ascontiguousarray(payload).tobytes(), c)
+    if scale is not None:
+        c = zlib.crc32(np.float32(scale).tobytes(), c)
+    return c & 0xFFFFFFFF
+
+
+def delta_nbytes(delta: dict) -> int:
+    """On-wire payload bytes of a delta doc (ids + rows + scales)."""
+    total = 0
+    for c in delta.get("chunks", ()):
+        total += int(np.asarray(c["ids"]).nbytes)
+        total += int(np.asarray(c["rows"]).nbytes)
+        if c.get("scale") is not None:
+            total += 4
+    return total
+
+
+# -- export (trainer side) --------------------------------------------------
+
+def export_table_delta(table, since_version: int = 0, *,
+                       encoding: str = "off", watermark=None,
+                       chunk_rows: int = 65536) -> dict:
+    """Snapshot the rows of ``table`` changed after ``since_version``.
+
+    Runs under the table's apply lock, so the exported rows and the
+    version they advance to are a consistent point-in-time cut -- a
+    concurrent ``push`` lands either wholly before (inside this delta) or
+    wholly after (in the next one), never half-applied.  Requires
+    ``table.arm_publisher()``; an export reaching below the dirty floor
+    (pre-arm history, or a bounded-set overflow) degrades to a full-table
+    delta (``full=True``) rather than silently dropping rows.
+    """
+    if encoding not in ENCODINGS:
+        raise ValueError(f"delta encoding must be one of {ENCODINGS}, "
+                         f"got {encoding!r}")
+    chunk_rows = max(1, int(chunk_rows))
+    since = int(since_version)
+    table.flush()                    # queued async pushes belong to this cut
+    with table._lock:
+        if table._dirty is None:
+            raise RuntimeError(
+                f"host table {table.name!r}: export_delta needs dirty "
+                f"tracking; call arm_publisher() before training starts")
+        version = table.push_count
+        full = since < table._dirty_floor
+        if full:
+            local = np.arange(table.row_hi - table.row_lo, dtype=np.int64)
+        else:
+            local = np.asarray(
+                sorted(i for i, v in table._dirty.items() if v > since),
+                dtype=np.int64)
+        rows = (np.array(table.table[local], np.float32, copy=True)
+                if len(local) else np.zeros((0, table.dim), np.float32))
+    ids = local + table.row_lo       # wire ids are always global
+    chunks: List[dict] = []
+    for off in range(0, len(ids), chunk_rows):
+        cid = ids[off:off + chunk_rows]
+        payload, scale = _encode_rows(rows[off:off + chunk_rows], encoding)
+        chunks.append({"ids": cid, "rows": payload, "scale": scale,
+                       "crc32": chunk_crc(cid, payload, scale)})
+    delta = {"format": DELTA_FORMAT, "table": table.name,
+             "vocab_size": table.vocab_size, "dim": table.dim,
+             "since_version": since, "version": version, "full": bool(full),
+             "encoding": encoding, "watermark": watermark,
+             "rows_total": int(len(ids)), "chunks": chunks}
+    _journal.emit({"event": "online_export", "table": table.name,
+                   "since": since, "version": version, "full": bool(full),
+                   "rows": int(len(ids)), "bytes": delta_nbytes(delta),
+                   "encoding": encoding})
+    return delta
+
+
+# -- verify / apply (serving side) ------------------------------------------
+
+def verify_delta(delta: dict) -> None:
+    """Structural + crc verification; raises :class:`DeltaError` /
+    :class:`DeltaCorrupt` and never mutates anything."""
+    if not isinstance(delta, dict) or delta.get("format") != DELTA_FORMAT:
+        raise DeltaError(
+            f"not a {DELTA_FORMAT} doc: format="
+            f"{getattr(delta, 'get', lambda *_: None)('format')!r}")
+    enc = delta.get("encoding")
+    if enc not in ENCODINGS:
+        raise DeltaError(f"unknown delta encoding {enc!r}")
+    dim = int(delta.get("dim", 0))
+    vocab = int(delta.get("vocab_size", 0))
+    total = 0
+    for i, c in enumerate(delta.get("chunks", ())):
+        ids = np.asarray(c.get("ids"))
+        rows = np.asarray(c.get("rows"))
+        if ids.ndim != 1:
+            raise DeltaCorrupt(f"chunk {i}: ids must be 1-d, "
+                               f"got shape {ids.shape}")
+        if rows.shape != (len(ids), dim):
+            raise DeltaCorrupt(
+                f"chunk {i}: torn payload -- rows shape {rows.shape} != "
+                f"({len(ids)}, {dim})")
+        if len(ids) and (ids.min() < 0 or ids.max() >= vocab):
+            raise DeltaError(
+                f"chunk {i}: ids outside [0, {vocab})")
+        if chunk_crc(ids, rows, c.get("scale")) != int(c.get("crc32", -1)):
+            raise DeltaCorrupt(
+                f"chunk {i}: crc32 mismatch (torn or bit-flipped payload)")
+        total += len(ids)
+    if total != int(delta.get("rows_total", -1)):
+        raise DeltaCorrupt(
+            f"rows_total {delta.get('rows_total')} != {total} chunk rows "
+            f"(truncated chunk list)")
+
+
+class TableReplica:
+    """A serving-side copy of one host table, advanced by verified deltas.
+
+    Reads are lock-free against an immutable array reference; ``apply``
+    builds the next array off to the side and commits it with an atomic
+    reference flip, so a gather concurrent with a publish sees wholly the
+    old or wholly the new rows -- the partial-swap analog of the pool's
+    generation flip.  Any rejection (:class:`DeltaError` and subclasses)
+    leaves the old array serving.
+    """
+
+    def __init__(self, name: str, vocab_size: int, dim: int, *,
+                 table: Optional[np.ndarray] = None, version: int = 0):
+        self.name = name
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        if table is None:
+            table = np.zeros((self.vocab_size, self.dim), np.float32)
+        table = np.asarray(table, np.float32)
+        if table.shape != (self.vocab_size, self.dim):
+            raise ValueError(
+                f"replica {name!r}: table shape {table.shape} != "
+                f"({self.vocab_size}, {self.dim})")
+        self.table = table
+        self.version = int(version)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_table(cls, table) -> "TableReplica":
+        """Bootstrap from a live :class:`HostTable`: a consistent snapshot
+        of rows + version under the table's apply lock."""
+        if table.row_shard:
+            raise ValueError(
+                f"host table {table.name!r} is row-sharded "
+                f"{table.row_shard}; a serving replica needs the full row "
+                f"range -- build it on the rank that assembles exports")
+        table.flush()
+        with table._lock:
+            snap = np.array(table.table, np.float32, copy=True)
+            version = table.push_count
+        return cls(table.name, table.vocab_size, table.dim,
+                   table=snap, version=version)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.table.nbytes)
+
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        """Lock-free minibatch row gather (the serve-time pull)."""
+        idx = np.asarray(ids, np.int64)
+        bad = (idx < 0) | (idx >= self.vocab_size)
+        if bad.any():
+            raise IndexError(
+                f"replica {self.name!r}: id(s) out of range "
+                f"[0, {self.vocab_size}), e.g. "
+                f"{np.unique(idx[bad])[:8].tolist()}")
+        t = self.table                       # one atomic reference read
+        return t[idx.reshape(-1)].reshape(idx.shape + (self.dim,))
+
+    def _check_applicable(self, delta: dict) -> None:
+        verify_delta(delta)
+        if delta["table"] != self.name:
+            raise DeltaError(f"delta targets table {delta['table']!r}, "
+                             f"replica holds {self.name!r}")
+        if (int(delta["vocab_size"]), int(delta["dim"])) != \
+                (self.vocab_size, self.dim):
+            raise DeltaError(
+                f"delta shape ({delta['vocab_size']}, {delta['dim']}) != "
+                f"replica ({self.vocab_size}, {self.dim})")
+        new_v, since = int(delta["version"]), int(delta["since_version"])
+        if new_v <= self.version:
+            raise DeltaStale(
+                f"delta version {new_v} <= replica version "
+                f"{self.version} (already applied?)")
+        if not delta["full"] and since > self.version:
+            raise DeltaError(
+                f"delta gap: covers ({since}, {new_v}] but replica is at "
+                f"{self.version} -- republish from version "
+                f"{self.version} (or send a full delta)")
+
+    def apply(self, delta: dict, validate_only: bool = False) -> int:
+        """Verify ``delta`` and commit it; returns the new version.
+
+        ``validate_only=True`` runs every check (structure, crc, shape,
+        version continuity against this replica) and mutates nothing --
+        the validation-replica leg of the pool's verify-then-commit."""
+        self._check_applicable(delta)
+        if validate_only:
+            return int(delta["version"])
+        enc = delta["encoding"]
+        with self._lock:
+            self._check_applicable(delta)    # re-check under the lock
+            new = self.table.copy()
+            for c in delta["chunks"]:
+                ids = np.asarray(c["ids"], np.int64)
+                if len(ids):
+                    new[ids] = _decode_rows(c["rows"], c.get("scale"), enc)
+            self.table = new                 # atomic reference flip
+            self.version = int(delta["version"])
+        return self.version

@@ -1,0 +1,182 @@
+"""OnlinePublisher: the trainer-side loop closing the click-to-model gap.
+
+The reference stack's async-pserver online pattern (train on the click
+stream, serve the updated embeddings seconds later) as one small driver:
+ride ``StepGuardian.train_from_dataset(step_cb=pub.step_cb)``, and at a
+step/seconds cadence export the host table's dirty rows as a
+``host_table_delta_v1`` doc (stamped with the stream watermark the rows
+were trained through) and push it into a ``PredictorPool`` via
+``apply_delta`` -- a partial hot swap: no checkpoint cycle, no recompile.
+
+Failure containment: a publish that dies mid-flight (an injected
+``exc@online_export``, a corrupt chunk the serving side rejects, a pool
+refusal) raises :class:`PublishError` *without* advancing the committed
+version, so the next cadence tick re-exports everything since the last
+delta the pool actually applied -- publishes resume, rows are never
+skipped.  ``step_cb`` absorbs the typed failure (counted + journaled);
+training never dies because serving refused a delta.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..observability import journal as _journal
+from ..observability.metrics import REGISTRY as _OBS
+from ..resilience import faults as _faults
+from .delta import delta_nbytes
+
+
+class PublishError(RuntimeError):
+    """One publish failed typed; the publisher's committed version is
+    unchanged and the next publish re-exports from it (resume)."""
+
+
+class OnlinePublisher:
+    """Export-and-apply driver for one host table into one serving pool.
+
+    Construct it BEFORE training starts: the constructor arms the table's
+    dirty tracking, and rows pushed while disarmed can only be shipped by
+    a full-table delta.  The pool must serve the table
+    (``PredictorPool(..., sparse_tables={name: table})``).
+    """
+
+    def __init__(self, table, pool, *, every_steps: Optional[int] = None,
+                 every_seconds: Optional[float] = None,
+                 encoding: str = "off", dataset=None,
+                 dirty_bound: int = 1_000_000, chunk_rows: int = 65536,
+                 clock=time.monotonic):
+        if every_steps is None and every_seconds is None:
+            raise ValueError(
+                "OnlinePublisher needs a cadence: every_steps and/or "
+                "every_seconds")
+        rep = (getattr(pool, "sparse_tables", None) or {}).get(table.name)
+        if rep is None:
+            raise ValueError(
+                f"pool serves no sparse table {table.name!r}; construct "
+                f"PredictorPool(..., sparse_tables={{{table.name!r}: "
+                f"table}}) so serve-time gathers read a replica")
+        self._table = table
+        self._pool = pool
+        self._every_steps = None if every_steps is None else int(every_steps)
+        self._every_seconds = (None if every_seconds is None
+                               else float(every_seconds))
+        self._encoding = encoding
+        self._dataset = dataset
+        self._chunk_rows = int(chunk_rows)
+        self._clock = clock
+        table.arm_publisher(bound=dirty_bound)
+        #: last table version the POOL committed; publishes resume from here
+        self._last_version = int(rep.version)
+        self._seq = 0
+        self._last_pub_step = 0
+        self._last_pub_t = clock()
+        #: one dict per successful publish (seq/version/rows/bytes/
+        #: watermark/publish_s/t_commit) -- what bench_online reads
+        self.history = []
+        self.failures = 0
+        self.last_error: Optional[BaseException] = None
+        self._c_bytes = _OBS.counter(
+            "delta_bytes_total",
+            "on-wire bytes of published host-table deltas",
+            table=table.name)
+        self._c_rows = _OBS.counter(
+            "delta_rows_total",
+            "rows shipped in published host-table deltas",
+            table=table.name)
+        self._h_publish = _OBS.histogram(
+            "online_publish_seconds",
+            "wall time of one delta publish (export + encode + apply)")
+
+    @property
+    def committed_version(self) -> int:
+        return self._last_version
+
+    def step_cb(self, batches_consumed: int, fetches=None):
+        """Cadence hook for ``train_from_dataset(step_cb=...)``: publish
+        when due; a failed publish is absorbed typed (``failures`` /
+        ``last_error`` / journal) so the training loop survives it."""
+        now = self._clock()
+        due = (self._every_steps is not None and
+               batches_consumed - self._last_pub_step >= self._every_steps)
+        if not due and self._every_seconds is not None:
+            due = now - self._last_pub_t >= self._every_seconds
+        if not due:
+            return None
+        self._last_pub_step = int(batches_consumed)
+        self._last_pub_t = now
+        try:
+            return self.publish(consumed=batches_consumed)
+        except PublishError as e:
+            self.failures += 1
+            self.last_error = e
+            return None
+
+    def publish(self, consumed: Optional[int] = None):
+        """Export-verify-apply one delta now; returns the publish record
+        (None when nothing changed), raises :class:`PublishError` typed on
+        any failure with the committed version unchanged."""
+        t0 = self._clock()
+        self._seq += 1
+        table = self._table
+        wm = None
+        if self._dataset is not None and consumed is not None:
+            wmf = getattr(self._dataset, "watermark", None)
+            if wmf is not None:
+                wm = wmf(consumed)
+        try:
+            delta = table.export_delta(
+                self._last_version, encoding=self._encoding, watermark=wm,
+                chunk_rows=self._chunk_rows)
+            if _faults._active:
+                # chaos seam: exc kills the publish after export, before
+                # apply (mid-flight); corrupt bit-flips a chunk so the
+                # serving-side crc rejection path runs for real
+                _faults.fire("online_export", step=self._seq,
+                             tags=(table.name,))
+                delta = _faults.corrupt_delta(delta, step=self._seq,
+                                              tags=(table.name,))
+            if delta["rows_total"] == 0 and not delta["full"]:
+                _journal.emit({"event": "online_publish", "outcome": "empty",
+                               "table": table.name, "seq": self._seq,
+                               "version": self._last_version})
+                return None
+            self._pool.apply_delta(delta)
+        except Exception as e:
+            self._h_publish.observe(self._clock() - t0)
+            _OBS.counter("online_publish_total",
+                         "delta publishes by outcome",
+                         outcome="error").inc()
+            _journal.emit({"event": "online_publish", "outcome": "error",
+                           "table": table.name, "seq": self._seq,
+                           "since": self._last_version,
+                           "error": str(e)[:200]})
+            raise PublishError(
+                f"publish #{self._seq} of table {table.name!r} failed; "
+                f"committed version stays {self._last_version}: "
+                f"{e}") from e
+        dt = self._clock() - t0
+        nbytes = delta_nbytes(delta)
+        self._last_version = int(delta["version"])
+        self._c_rows.inc(delta["rows_total"])
+        self._c_bytes.inc(nbytes)
+        self._h_publish.observe(dt)
+        _OBS.counter("online_publish_total", "delta publishes by outcome",
+                     outcome="ok").inc()
+        rec = {"seq": self._seq, "version": self._last_version,
+               "rows": int(delta["rows_total"]), "bytes": int(nbytes),
+               "full": bool(delta["full"]), "encoding": self._encoding,
+               "watermark": wm, "publish_s": float(dt),
+               "t_commit": self._clock()}
+        self.history.append(rec)
+        _journal.emit({"event": "online_publish", "outcome": "ok",
+                       "table": table.name, "seq": self._seq,
+                       "version": self._last_version,
+                       "rows": rec["rows"], "bytes": rec["bytes"],
+                       "full": rec["full"], "encoding": self._encoding,
+                       "publish_ms": round(dt * 1e3, 3)})
+        return rec
+
+    def close(self):
+        """Stop dirty tracking (push hot path back to one attr read)."""
+        self._table.disarm_publisher()

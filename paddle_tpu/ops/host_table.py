@@ -146,6 +146,14 @@ class HostTable:
                 full_shape)[self.row_lo:self.row_hi]
         self._lock = threading.Lock()
         self.push_count = 0
+        # online-publisher dirty tracking: None while disarmed so the push
+        # hot path pays exactly one attribute read (spy-guard-tested).  When
+        # armed, maps LOCAL row index -> table version (push_count) of its
+        # last update; bounded -- on overflow the map is dropped and
+        # _dirty_floor rises, forcing the next export to ship the full table.
+        self._dirty: Optional[Dict[int, int]] = None
+        self._dirty_bound = 0
+        self._dirty_floor = 0
         self._closed = False
         self._worker_error: Optional[BaseException] = None
         self._async = bool(async_updates)
@@ -316,6 +324,59 @@ class HostTable:
             else:
                 self.table[uniq] -= self.lr * acc
             self.push_count += 1
+            if self._dirty is not None:
+                self._note_dirty(uniq)
+
+    # ---- online publishing ------------------------------------------------
+    @property
+    def version(self) -> int:
+        """Monotone table version: the number of applied pushes (survives
+        checkpoint save/load via the npz meta)."""
+        return self.push_count
+
+    def arm_publisher(self, bound: int = 1_000_000):
+        """Start dirty-row tracking so ``export_delta`` can ship only the
+        rows touched since a version.  ``bound`` caps the tracked-id map;
+        overflowing it degrades the NEXT export to a full-table publish
+        (correct, just not incremental) rather than growing without limit."""
+        with self._lock:
+            if self._dirty is None:
+                self._dirty = {}
+                # rows dirtied before arming are unknown: exports reaching
+                # below this floor must ship the full table
+                self._dirty_floor = self.push_count
+            self._dirty_bound = int(bound)
+
+    def disarm_publisher(self):
+        """Stop dirty tracking and drop the map (push hot path back to the
+        single ``_dirty is None`` attribute read)."""
+        with self._lock:
+            self._dirty = None
+
+    def _note_dirty(self, uniq):
+        """Record locally-indexed rows ``uniq`` as dirty at the current
+        version.  Caller holds ``self._lock`` (called from ``_apply``)."""
+        d = self._dirty
+        v = self.push_count
+        for i in uniq.tolist():
+            d[int(i)] = v
+        if len(d) > self._dirty_bound:
+            # bounded set overflow: forget row granularity, remember only
+            # that everything up to v may be dirty (next export goes full)
+            d.clear()
+            self._dirty_floor = v
+
+    def export_delta(self, since_version: int = 0, *, encoding: str = "off",
+                     watermark=None, chunk_rows: int = 65536) -> dict:
+        """Atomic snapshot of the rows changed after ``since_version`` as a
+        ``host_table_delta_v1`` doc: chunked ids + rows (optionally
+        int8/bf16-encoded via ``comm/compress``), per-chunk crc32, the
+        stream ``watermark`` the rows were trained through, and the table
+        version the delta advances to.  Requires ``arm_publisher()``; see
+        ``paddle_tpu.online.delta`` for the format and the apply side."""
+        from ..online.delta import export_table_delta
+        return export_table_delta(self, since_version, encoding=encoding,
+                                  watermark=watermark, chunk_rows=chunk_rows)
 
     # ---- persistence -----------------------------------------------------
     def _ckpt_path(self, dirname: str) -> str:
@@ -326,8 +387,12 @@ class HostTable:
         return os.path.join(dirname, f"host_table.{self.name}{suffix}.npz")
 
     def save(self, dirname: str):
-        os.makedirs(dirname, exist_ok=True)
+        # snapshot consistency: flush() drains pending async pushes first
+        # (a queued push applied mid-save would otherwise write a
+        # half-updated row), then the apply lock is held across the whole
+        # savez so no concurrent _apply can interleave table/accum/meta
         self.flush()
+        os.makedirs(dirname, exist_ok=True)
         with self._lock:
             np.savez(self._ckpt_path(dirname),
                      table=np.asarray(self.table),

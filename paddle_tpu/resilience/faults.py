@@ -51,7 +51,12 @@ data-plane sites ``read`` (a streaming source delivering one record: an
 absorb, a ``hang`` is a stalled feed, a ``corrupt`` garbles the record
 text into a poison line -- see :func:`corrupt_record`) and ``parse``
 (the line parser: ``corrupt@parse`` garbles the line at parse time,
-``exc@parse`` fails the parse -- both land in the quarantine path).
+``exc@parse`` fails the parse -- both land in the quarantine path), and
+the online-learning site ``online_export`` (the publisher's
+export->apply seam: an ``exc`` kills a publish mid-flight, a
+``corrupt`` bit-flips a delta chunk's row payload -- see
+:func:`corrupt_delta` -- so the serving-side crc rejection path is
+exercised; the old model version must keep serving either way).
 Keys: ``step`` (program step index / serving batch sequence / stream
 record index at ``read``/``parse``, omit = every step), ``var`` (tensor
 name at training sites; at ``serve_*`` sites a TENANT name -- the fault
@@ -83,7 +88,8 @@ ENV_VAR = "PADDLE_TPU_FAULTS"
 
 KINDS = ("nan", "exc", "hang", "preempt", "kill", "corrupt", "truncate")
 SITES = ("compile", "dispatch", "fetch", "checkpoint_write",
-         "serve_dispatch", "serve_fetch", "serve_hang", "read", "parse")
+         "serve_dispatch", "serve_fetch", "serve_hang", "read", "parse",
+         "online_export")
 #: sites fired from the serving tier (PredictorPool workers); ``var`` at
 #: these sites names a tenant, not a tensor
 SERVING_SITES = ("serve_dispatch", "serve_fetch", "serve_hang")
@@ -446,6 +452,52 @@ def corrupt_record(text: str, site: str = "read",
         text = ("\x7fCORRUPT\x7f " +
                 text.replace(";", " ").strip() + " ;;;")
     return text
+
+
+def corrupt_delta(delta: dict, step: Optional[int] = None,
+                  tags: Optional[Sequence[str]] = None) -> dict:
+    """Hook point: apply armed ``corrupt@online_export`` faults to a
+    host-table delta doc (called by ``OnlinePublisher`` between export and
+    apply, only when faults are armed).  Flips one bit of a seeded-random
+    chunk's row payload -- ids and sizes unchanged, so only the per-chunk
+    crc32 on the apply side can catch it (the torn-delta rejection
+    contract: serving must keep the old version, typed).  The input doc is
+    not mutated; a damaged shallow copy is returned.  ``step`` is the
+    publish sequence number; ``var`` narrows to one table (via ``tags``)."""
+    if not _active:
+        return delta
+    import numpy as np
+    for f in _active:
+        if f.kind != "corrupt" or f.site != "online_export" \
+                or not f.matches("online_export", step, tags):
+            continue
+        chunks = list((delta or {}).get("chunks") or [])
+        victims = [i for i, c in enumerate(chunks)
+                   if getattr(c.get("rows"), "nbytes", 0)]
+        if not victims:
+            f.missed += 1
+            if f.missed == 1:
+                _journal.emit({"event": "fault_miss", "kind": f.kind,
+                               "step": step, "var": f.var,
+                               "detail": "delta has no row payload to "
+                                         "corrupt; fault not consumed"})
+            continue
+        ci = victims[f._rng.randrange(len(victims))]
+        c = dict(chunks[ci])
+        rows = np.ascontiguousarray(c["rows"])
+        buf = bytearray(rows.tobytes())
+        pos = f._rng.randrange(len(buf))
+        buf[pos] ^= 0x01
+        c["rows"] = np.frombuffer(bytes(buf),
+                                  dtype=rows.dtype).reshape(rows.shape)
+        chunks[ci] = c
+        delta = dict(delta)
+        delta["chunks"] = chunks
+        _record(f, "online_export", step, var=f.var)
+        _journal.emit({"event": "delta_fault", "kind": f.kind,
+                       "table": delta.get("table"), "chunk": ci,
+                       "step": step, "detail": f"bit-flip at byte {pos}"})
+    return delta
 
 
 def mutate_checkpoint(dirname, step: Optional[int] = None) -> List[dict]:

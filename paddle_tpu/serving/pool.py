@@ -390,11 +390,22 @@ class PredictorPool:
                  breaker_backoff_s: float = 1.0,
                  breaker_backoff_max_s: float = 30.0,
                  check_outputs: bool = False,
-                 start_workers: bool = True):
+                 start_workers: bool = True,
+                 sparse_tables: Optional[Dict[str, object]] = None):
         if dtype not in (None, "auto", "float32", "bfloat16"):
             raise ValueError(
                 f"pool dtype {dtype!r} invalid; use None, 'auto', "
                 f"'float32' or 'bfloat16'")
+        # online serving: one shared TableReplica per sparse table -- the
+        # predictors' hoisted embedding gathers read it, apply_delta
+        # advances it (partial hot push, no recompile).  Values may be
+        # live HostTables (snapshotted here) or prebuilt replicas.
+        self._sparse: Dict[str, object] = {}
+        if sparse_tables:
+            from ..online.delta import TableReplica
+            for name, src in sparse_tables.items():
+                self._sparse[name] = (src if isinstance(src, TableReplica)
+                                      else TableReplica.from_table(src))
         if predictors is None:
             if model_dir is None:
                 raise ValueError("PredictorPool needs model_dir or "
@@ -403,9 +414,16 @@ class PredictorPool:
                 raise ValueError("size must be >= 1")
             from ..inference import Predictor
             session_dtype = dtype if dtype in ("float32", "bfloat16") else None
+            kw = {"sparse_tables": self._sparse} if self._sparse else {}
             predictors = [Predictor(model_dir, model_filename,
-                                    params_filename, dtype=session_dtype)
+                                    params_filename, dtype=session_dtype,
+                                    **kw)
                           for _ in range(int(size))]
+        elif not self._sparse:
+            # prebuilt predictors carry their own replicas; adopt them so
+            # apply_delta and the publisher see the same objects
+            self._sparse = dict(getattr(predictors[0], "_sparse_tables",
+                                        None) or {})
         self._dtype = dtype
         self._predictors = list(predictors)
         self._clock = clock or MonotonicClock()
@@ -889,10 +907,12 @@ class PredictorPool:
         if model_dir is not None:
             state = self._load_swap_state(model_dir, verify=verify)
         # validate against one live predictor before staging: a shape or
-        # dtype mismatch is typed rejection, not a wedged worker later
+        # dtype mismatch -- or a bad sparse delta riding a "sparse:<table>"
+        # key -- is typed rejection, not a wedged worker later
+        from ..online.delta import DeltaError
         try:
             self._predictors[0].swap_state(state, validate_only=True)
-        except ValueError as e:
+        except (ValueError, DeltaError) as e:
             _OBS.counter("serving_swap_total", "hot swaps by outcome",
                          outcome="rejected").inc()
             _journal.emit({"event": "serve_swap", "outcome": "rejected",
@@ -946,6 +966,70 @@ class PredictorPool:
         if t0 is not None:
             ev["swap_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
         _journal.emit(ev)
+
+    # -- online partial hot push -------------------------------------------
+    @property
+    def sparse_tables(self) -> Dict[str, object]:
+        """name -> shared serving ``TableReplica`` (the online
+        partial-push targets; what ``OnlinePublisher`` resumes from)."""
+        return dict(self._sparse)
+
+    def apply_delta(self, delta: dict) -> int:
+        """Partial hot push: advance one sparse table's serving replica by
+        a verified ``host_table_delta_v1`` doc.
+
+        Same verify-on-replica-then-commit discipline as :meth:`swap`,
+        but PARTIAL: no checkpoint cycle, no predictor rotation, no
+        recompile -- the hoisted sparse feed path gathers from the
+        replica array, whose reference flips atomically, so in-flight
+        batches finish on the old rows and the next gather sees the new.
+        A torn/corrupt/stale/gapped delta is rejected typed
+        (:class:`ServingError`) with the old version still serving.
+        Returns the new pool ``model_version``."""
+        import time as _time
+        from ..online.delta import DeltaError, sparse_state_key
+        t0 = _time.perf_counter()
+        name = delta.get("table") if isinstance(delta, dict) else None
+
+        def _reject(err):
+            _OBS.counter("online_apply_total",
+                         "serving-side delta applies by outcome",
+                         outcome="rejected").inc()
+            _journal.emit({"event": "online_apply", "outcome": "rejected",
+                           "table": name, "error": str(err)[:200]})
+            raise ServingError(f"delta apply rejected: {err}")
+
+        rep = self._sparse.get(name)
+        if rep is None:
+            _reject(f"pool serves no sparse table {name!r} "
+                    f"(have {sorted(self._sparse) or 'none'})")
+        try:
+            # the validation leg: every structural/crc/shape/version check,
+            # run through a live predictor's swap_state, nothing mutated
+            self._predictors[0].swap_state({sparse_state_key(name): delta},
+                                           validate_only=True)
+            rep.apply(delta)
+        except (ValueError, DeltaError) as e:
+            _reject(e)
+        with self._swap_cond:
+            target = self._model_version + 1
+            self._model_version = target
+            self._staged_version = max(self._staged_version, target)
+        for p in self._predictors:
+            p.model_version = target
+        self._g_version.set(target)
+        self._last_swap_t = self._clock.now()
+        self._g_staleness.set(0.0)
+        _OBS.counter("online_apply_total",
+                     "serving-side delta applies by outcome",
+                     outcome="ok").inc()
+        _journal.emit({"event": "online_apply", "outcome": "ok",
+                       "table": name, "model_version": target,
+                       "table_version": rep.version,
+                       "rows": delta.get("rows_total"),
+                       "apply_ms": round((_time.perf_counter() - t0) * 1e3,
+                                         3)})
+        return target
 
     def _load_swap_state(self, model_dir: str,
                          verify: bool = True) -> Dict[str, object]:

@@ -1,0 +1,585 @@
+"""Online learning subsystem (paddle_tpu/online/): host-table delta
+export, the serving-side replica + partial hot push, the publisher loop,
+and the chaos/SLO discipline around them.
+
+The load-bearing claims pinned here:
+
+- the table push hot path pays ONE attribute read while no publisher is
+  armed (spy-guard on ``_note_dirty``);
+- ``export_delta`` is an atomic point-in-time cut: incremental after
+  arming, degrading to ``full=True`` (never silently dropping rows) when
+  the export reaches below the dirty floor -- pre-arm history or a
+  bounded-set overflow;
+- a delta round-trips through every encoding (off/bf16/int8) within the
+  codec's tolerance, and a sparse delta is a small fraction of the
+  full-table bytes;
+- the serving replica rejects stale/gapped/torn deltas TYPED with the
+  old rows still serving, and ``PredictorPool.apply_delta`` is a partial
+  hot push: new rows served with the executable cache miss count pinned
+  (no recompile), ``model_version`` bumped, staleness reset;
+- ``swap_state(validate_only=True)`` covers sparse state: a bad delta is
+  rejected on the validation replica before any live predictor commits;
+- ``OnlinePublisher`` rides ``train_from_dataset(step_cb=...)`` at a
+  step cadence, stamping each publish with the stream watermark;
+- chaos: a publisher killed mid-export (exc@online_export) and a
+  bit-flipped chunk (corrupt@online_export) both fail typed, serving
+  keeps the old version, and publishing RESUMES from the last committed
+  table version -- no row is ever skipped;
+- ``HostTable.save()`` drains in-flight async applies before
+  snapshotting (gated-thread regression);
+- the shipped ``model-freshness`` SLO rule evaluates against the real
+  ``model_staleness_seconds`` gauge: no-data never false-fires, an aged
+  pool fires, a publish resolves.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.data import GeneratorSource, StreamingDataset
+from paddle_tpu.inference import Predictor
+from paddle_tpu.initializer import NumpyArrayInitializer
+from paddle_tpu.layer_helper import ParamAttr
+from paddle_tpu.observability import journal as obs_journal
+from paddle_tpu.observability import slo
+from paddle_tpu.observability.metrics import REGISTRY, MetricsRegistry
+from paddle_tpu.online import (DeltaCorrupt, DeltaError, DeltaStale,
+                               OnlinePublisher, PublishError, TableReplica,
+                               delta_nbytes, sparse_state_key, verify_delta)
+from paddle_tpu.ops import host_table as ht
+from paddle_tpu.resilience import faults, recovery
+from paddle_tpu.serving import FakeClock, PredictorPool, ServingError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB, DIM, FIELDS = 32, 4, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _fresh_table(name, vocab=VOCAB, dim=DIM, **kw):
+    ht.drop_table(name)
+    rng = np.random.RandomState(11)
+    kw.setdefault("initializer",
+                  rng.uniform(-1, 1, (vocab, dim)).astype(np.float32))
+    return ht.create_table(name, vocab, dim, optimizer="sgd", lr=1.0, **kw)
+
+
+def _push(table, ids, seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    ids = np.asarray(ids, np.int64)
+    table.push(ids, scale * rng.randn(len(ids), table.dim)
+               .astype(np.float32))
+
+
+# -- shared serve model: ids -> host_embedding -> fc -> pred ---------------
+
+class _Model:
+    def __init__(self, dirname, name):
+        self.dir, self.name = dirname, name
+        ht.drop_table(name)
+        rng = np.random.RandomState(5)
+        w0 = rng.uniform(-0.1, 0.1, (VOCAB, DIM)).astype(np.float32)
+        fc_w = rng.uniform(-0.1, 0.1, (FIELDS * DIM, 1)).astype(np.float32)
+        self.main, self.startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), \
+                fluid.program_guard(self.main, self.startup):
+            ids = layers.data("ids", shape=[FIELDS], dtype="int64")
+            y = layers.data("y", shape=[1], dtype="float32")
+            emb = layers.host_embedding(ids, (VOCAB, DIM), name=name,
+                                        optimizer="sgd", learning_rate=0.1,
+                                        initializer=w0)
+            flat = layers.reshape(emb, [-1, FIELDS * DIM])
+            pred = layers.fc(flat, 1, param_attr=ParamAttr(
+                name="online_fc_w",
+                initializer=NumpyArrayInitializer(fc_w)), bias_attr=False)
+            self.loss = layers.mean(layers.square(
+                layers.elementwise_sub(pred, y)))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(self.loss)
+        self.ids_var = self.main.global_block().vars["ids"]
+        self.y_var = self.main.global_block().vars["y"]
+        self.exe = fluid.Executor()
+        self.scope = fluid.Scope()
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup)
+            fluid.io.save_inference_model(dirname, ["ids"], [pred],
+                                          self.exe, self.main)
+
+    @property
+    def table(self):
+        return ht.get_table(self.name)
+
+    def train(self, steps, seed=7):
+        rng = np.random.RandomState(seed)
+        with fluid.scope_guard(self.scope):
+            for _ in range(steps):
+                feed = {"ids": rng.randint(0, VOCAB, (4, FIELDS))
+                        .astype(np.int64),
+                        "y": rng.randn(4, 1).astype(np.float32)}
+                self.exe.run(self.main, feed=feed, fetch_list=[self.loss])
+
+
+@pytest.fixture(scope="module")
+def model(tmp_path_factory):
+    m = _Model(str(tmp_path_factory.mktemp("online_model")), "online_emb")
+    yield m
+    ht.drop_table(m.name)
+
+
+def _pool(model, **kw):
+    kw.setdefault("start_workers", False)
+    kw.setdefault("sparse_tables", {model.name: model.table})
+    return PredictorPool(model.dir, **kw)
+
+
+def _corrupted(delta, chunk=0):
+    """Bit-flip one payload byte of a chunk (a torn publish on the wire)."""
+    bad = dict(delta)
+    chunks = [dict(c) for c in bad["chunks"]]
+    rows = np.array(chunks[chunk]["rows"], copy=True)
+    rows.view(np.uint8).reshape(-1)[0] ^= 0x01
+    chunks[chunk]["rows"] = rows
+    bad["chunks"] = chunks
+    return bad
+
+
+# ------------------------------------------------ dirty tracking / export --
+
+def test_disarmed_push_is_one_attr_read_spy_guard(monkeypatch):
+    """No publisher armed => the push hot path never enters dirty
+    bookkeeping (the pay-nothing-if-unused contract)."""
+    calls = []
+    orig = ht.HostTable._note_dirty
+
+    def spy(self, uniq):
+        calls.append(len(uniq))
+        return orig(self, uniq)
+
+    monkeypatch.setattr(ht.HostTable, "_note_dirty", spy)
+    t = _fresh_table("spy_tbl")
+    try:
+        _push(t, [1, 2, 3])
+        assert calls == [] and t._dirty is None
+        t.arm_publisher()
+        _push(t, [4, 5])
+        assert calls == [2]   # one tracked batch of 2 uniq ids
+        t.disarm_publisher()
+        _push(t, [6])
+        assert calls == [2] and t._dirty is None
+    finally:
+        ht.drop_table("spy_tbl")
+
+
+def test_export_delta_incremental_and_encodings_roundtrip():
+    """An armed table exports exactly the rows touched since a version;
+    every encoding round-trips through a replica within codec tolerance;
+    a sparse int8 delta is well under 20% of the full-table bytes."""
+    t = _fresh_table("enc_tbl")
+    try:
+        t.arm_publisher()
+        _push(t, [3, 7, 9], seed=1)
+        v1 = t.version
+        _push(t, [7, 20], seed=2)
+        delta = t.export_delta(0)
+        assert delta["format"] == "host_table_delta_v1"
+        assert not delta["full"] and delta["version"] == t.version
+        assert delta["chunks"][0]["ids"].tolist() == [3, 7, 9, 20]
+        verify_delta(delta)
+        # only the second push's rows after v1
+        d2 = t.export_delta(v1)
+        assert d2["chunks"][0]["ids"].tolist() == [7, 20]
+
+        full = t.export_delta(0)
+        for enc in ("off", "bf16", "int8"):
+            d = t.export_delta(0, encoding=enc, watermark={"records": 5})
+            assert d["watermark"] == {"records": 5}
+            rep = TableReplica(t.name, VOCAB, DIM)
+            rep.apply(d)
+            got = rep.gather(np.array([3, 7, 9, 20]))
+            want = t.table[[3, 7, 9, 20]]
+            atol = {"off": 0.0, "bf16": 0.02, "int8": 0.05}[enc]
+            np.testing.assert_allclose(got, want, atol=atol)
+            if enc == "off":
+                assert got.tobytes() == np.ascontiguousarray(want).tobytes()
+        sparse_int8 = t.export_delta(0, encoding="int8")
+        assert delta_nbytes(sparse_int8) < 0.2 * (
+            delta_nbytes(full) + VOCAB * DIM * 4 - delta_nbytes(full)
+            or delta_nbytes(full))
+        assert delta_nbytes(sparse_int8) < 0.2 * (VOCAB * DIM * 4)
+    finally:
+        ht.drop_table("enc_tbl")
+
+
+def test_export_needs_arm_and_prearm_history_goes_full():
+    t = _fresh_table("floor_tbl")
+    try:
+        _push(t, [1, 2])
+        with pytest.raises(RuntimeError, match="arm_publisher"):
+            t.export_delta(0)
+        t.arm_publisher()          # floor = 2 pushes of pre-arm history
+        _push(t, [5])
+        # reaching below the floor can't enumerate pre-arm rows: full ship
+        d = t.export_delta(0)
+        assert d["full"] and d["rows_total"] == VOCAB
+        assert d["chunks"][0]["ids"].tolist() == list(range(VOCAB))
+        # at/above the floor it's incremental again
+        d2 = t.export_delta(t._dirty_floor)
+        assert not d2["full"] and d2["chunks"][0]["ids"].tolist() == [5]
+    finally:
+        ht.drop_table("floor_tbl")
+
+
+def test_dirty_overflow_degrades_next_export_to_full():
+    t = _fresh_table("bound_tbl")
+    try:
+        t.arm_publisher(bound=4)
+        v0 = t.version
+        _push(t, [0, 1, 2, 3, 4, 5])   # 6 uniq rows > bound: overflow
+        d = t.export_delta(v0)
+        assert d["full"] and d["rows_total"] == VOCAB
+        # tracking continues past the raised floor
+        ov = t.version
+        _push(t, [9, 10])
+        d2 = t.export_delta(ov)
+        assert not d2["full"] and d2["chunks"][0]["ids"].tolist() == [9, 10]
+    finally:
+        ht.drop_table("bound_tbl")
+
+
+# ----------------------------------------------------- replica discipline --
+
+def test_replica_rejects_stale_gap_and_corrupt_typed():
+    t = _fresh_table("rep_tbl")
+    try:
+        t.arm_publisher()
+        rep = TableReplica.from_table(t)
+        v0 = rep.version
+        _push(t, [2, 6], seed=3)
+        d1 = t.export_delta(v0)
+        _push(t, [8], seed=4)
+        d2 = t.export_delta(d1["version"])
+
+        # corrupt: typed rejection, old rows still serving
+        before = rep.gather(np.array([2, 6])).copy()
+        with pytest.raises(DeltaCorrupt, match="crc32"):
+            rep.apply(_corrupted(d1))
+        assert rep.version == v0
+        assert rep.gather(np.array([2, 6])).tobytes() == before.tobytes()
+
+        # gap: d2 covers (v1, v2] but the replica is still at v0
+        with pytest.raises(DeltaError, match="gap"):
+            rep.apply(d2)
+        assert rep.version == v0
+
+        assert rep.apply(d1) == d1["version"]
+        assert rep.apply(d2) == d2["version"] == t.version
+        np.testing.assert_array_equal(rep.gather(np.array([2, 6, 8])),
+                                      t.table[[2, 6, 8]])
+        # stale: an already-applied delta never rolls the replica back
+        with pytest.raises(DeltaStale):
+            rep.apply(d1)
+    finally:
+        ht.drop_table("rep_tbl")
+
+
+# --------------------------------------------- pool: partial hot push -----
+
+def _misses():
+    return REGISTRY.counter("predictor_executable_cache_total",
+                            outcome="miss").value
+
+
+def test_pool_partial_hot_push_serves_new_rows_no_recompile(model):
+    """apply_delta is a partial state swap: the pool serves the updated
+    rows with the executable-cache miss count pinned (no recompile) and
+    the model_version bumped -- and every predictor sees the shared
+    replica."""
+    model.train(2, seed=21)
+    pool = _pool(model, size=2)
+    p0, p1 = pool._predictors
+    ids = np.array([[1, 5, 9], [2, 5, 30]], np.int64)
+    out0 = p0.run({"ids": ids})[0]
+    misses0 = _misses()
+    v_model = pool.model_version
+
+    t = model.table
+    t.arm_publisher()
+    rep = pool.sparse_tables[model.name]
+    since = rep.version
+    model.train(3, seed=22)
+    assert t.version > since
+    delta = t.export_delta(since)
+    assert pool.apply_delta(delta) == v_model + 1
+    assert pool.model_version == v_model + 1
+    assert rep.version == t.version
+
+    out1 = p0.run({"ids": ids})[0]
+    assert out1.tobytes() != out0.tobytes(), \
+        "published rows did not reach the serve path"
+    assert _misses() == misses0, "partial hot push caused a recompile"
+    # the second predictor gathers from the same replica: byte-equal
+    np.testing.assert_array_equal(p1.run({"ids": ids})[0], out1)
+    # and matches a cold predictor built on a fresh snapshot of the table
+    ref = Predictor(model.dir, sparse_tables={
+        model.name: TableReplica.from_table(t)})
+    np.testing.assert_array_equal(ref.run({"ids": ids})[0], out1)
+
+
+def test_swap_state_validate_only_covers_sparse(model):
+    """Satellite: the validation-replica leg rejects a bad sparse delta
+    before ANY live predictor commits -- and a passing validate_only
+    mutates nothing."""
+    pool = _pool(model, size=1)
+    t = model.table
+    t.arm_publisher()
+    rep = pool.sparse_tables[model.name]
+    since = rep.version
+    _push(t, [4, 11], seed=9)
+    delta = t.export_delta(since)
+    p = pool._predictors[0]
+
+    key = sparse_state_key(model.name)
+    with pytest.raises(DeltaCorrupt):
+        p.swap_state({key: _corrupted(delta)}, validate_only=True)
+    assert rep.version == since            # nothing staged, nothing moved
+
+    p.swap_state({key: delta}, validate_only=True)
+    assert rep.version == since            # validate_only never commits
+
+    with pytest.raises(ValueError, match="unknown_tbl"):
+        p.swap_state({sparse_state_key("unknown_tbl"): delta},
+                     validate_only=True)
+    # the full-swap entry point routes through the same validation leg
+    with pytest.raises(ServingError, match="swap rejected"):
+        pool.swap(state={key: _corrupted(delta)})
+    assert rep.version == since
+
+
+def test_pool_apply_delta_rejects_typed_old_version_serving(model):
+    pool = _pool(model, size=1)
+    t = model.table
+    t.arm_publisher()
+    rep = pool.sparse_tables[model.name]
+    since, v_model = rep.version, pool.model_version
+    _push(t, [3, 17], seed=13)
+    delta = t.export_delta(since)
+    p = pool._predictors[0]
+    ids = np.array([[3, 17, 0]], np.int64)
+    out_old = p.run({"ids": ids})[0]
+
+    rejected0 = REGISTRY.counter("online_apply_total",
+                                 outcome="rejected").value
+    with pytest.raises(ServingError, match="delta apply rejected"):
+        pool.apply_delta(_corrupted(delta))
+    assert pool.model_version == v_model and rep.version == since
+    assert p.run({"ids": ids})[0].tobytes() == out_old.tobytes()
+    assert REGISTRY.counter("online_apply_total",
+                            outcome="rejected").value == rejected0 + 1
+
+    pool.apply_delta(delta)
+    with pytest.raises(ServingError):      # stale re-publish: typed, no-op
+        pool.apply_delta(delta)
+    assert pool.model_version == v_model + 1
+
+    with pytest.raises(ServingError, match="no sparse table"):
+        pool.apply_delta({"format": "host_table_delta_v1",
+                          "table": "nope"})
+
+
+# ------------------------------------------------- publisher + guardian ---
+
+def test_publisher_rides_train_from_dataset_with_watermark(model):
+    """The closed loop: StepGuardian streams batches, the publisher
+    fires every N steps, each publish is stamped with the stream
+    watermark it was trained through, and the pool's replica tracks the
+    table version."""
+    obs_journal.clear()
+    pool = _pool(model, size=1)
+    rng = np.random.RandomState(3)
+    lines = [" ".join(str(x) for x in rng.randint(0, VOCAB, FIELDS)) +
+             f";{rng.randn():.4f}" for _ in range(12)]
+    ds = StreamingDataset()
+    ds.add_source(GeneratorSource(lambda: iter(lines), name="clicks"))
+    ds.set_use_var([model.ids_var, model.y_var])
+    ds.set_batch_size(2)
+
+    pub = OnlinePublisher(model.table, pool, every_steps=3,
+                          encoding="int8", dataset=ds)
+    v_model = pool.model_version
+    with fluid.scope_guard(model.scope):
+        g = recovery.StepGuardian(model.exe, model.main)
+        g.train_from_dataset(dataset=ds, fetch_list=[model.loss],
+                             step_cb=pub.step_cb)
+        g.close()
+
+    assert len(pub.history) == 2 and pub.failures == 0
+    # 12 records / batch 2 = 6 batches; cadence 3 => watermarks at 6, 12
+    assert [r["watermark"]["records"] for r in pub.history] == [6, 12]
+    assert pub.committed_version == model.table.version
+    assert pool.sparse_tables[model.name].version == model.table.version
+    assert pool.model_version == v_model + 2
+    evs = obs_journal.recent(event="online_publish")
+    assert sum(e["outcome"] == "ok" for e in evs) == 2
+    assert REGISTRY.counter("delta_rows_total",
+                            table=model.name).value > 0
+    rec = pub.history[-1]
+    assert rec["encoding"] == "int8" and rec["bytes"] == \
+        delta_nbytes(model.table.export_delta(pub.history[0]["version"],
+                                              encoding="int8"))
+
+
+def test_publisher_empty_cycle_is_a_noop(model):
+    obs_journal.clear()
+    pool = _pool(model, size=1)
+    pub = OnlinePublisher(model.table, pool, every_steps=1)
+    v = pool.model_version
+    assert pub.publish() is None           # nothing dirty: nothing shipped
+    assert pool.model_version == v and pub.history == []
+    evs = obs_journal.recent(event="online_publish")
+    assert evs and evs[-1]["outcome"] == "empty"
+
+
+def test_publisher_needs_cadence_and_a_serving_replica(model):
+    pool = _pool(model, size=1)
+    with pytest.raises(ValueError, match="cadence"):
+        OnlinePublisher(model.table, pool)
+    other = _fresh_table("unserved_tbl")
+    try:
+        with pytest.raises(ValueError, match="no sparse table"):
+            OnlinePublisher(other, pool, every_steps=1)
+    finally:
+        ht.drop_table("unserved_tbl")
+
+
+# ----------------------------------------------------------------- chaos --
+
+def test_chaos_publisher_killed_mid_export_resumes(model):
+    """exc@online_export kills a publish after export, before apply: the
+    committed version does not advance, step_cb absorbs the casualty
+    typed, and the NEXT publish re-ships every row since the last commit
+    -- nothing skipped."""
+    pool = _pool(model, size=1)
+    pub = OnlinePublisher(model.table, pool, every_steps=1)
+    t = model.table
+    rep = pool.sparse_tables[model.name]
+    committed = pub.committed_version
+    _push(t, [1, 2], seed=31)
+
+    faults.install("exc@online_export:times=1")
+    with pytest.raises(PublishError, match="committed version stays"):
+        pub.publish()
+    assert pub.committed_version == committed and rep.version == committed
+
+    _push(t, [5], seed=32)
+    faults.install("exc@online_export:times=1")
+    assert pub.step_cb(10) is None         # absorbed: training survives
+    assert pub.failures == 1 and isinstance(pub.last_error, PublishError)
+
+    faults.clear()
+    rec = pub.publish()                    # resume covers BOTH failed cuts
+    assert rec["version"] == t.version
+    assert rec["rows"] == 3                # rows {1, 2, 5}, none skipped
+    np.testing.assert_array_equal(rep.gather(np.array([1, 2, 5])),
+                                  t.table[[1, 2, 5]])
+
+
+def test_chaos_bitflip_delta_rejected_serving_keeps_old(model):
+    """corrupt@online_export bit-flips a chunk on the wire: the serving
+    side rejects it on crc (typed, never a hang), the old version keeps
+    serving, and publishing resumes once the fault clears."""
+    pool = _pool(model, size=1)
+    pub = OnlinePublisher(model.table, pool, every_steps=1)
+    t = model.table
+    rep = pool.sparse_tables[model.name]
+    committed, v_model = pub.committed_version, pool.model_version
+    _push(t, [7, 21], seed=41)
+
+    faults.install("corrupt@online_export:times=1")
+    with pytest.raises(PublishError) as ei:
+        pub.publish()
+    assert isinstance(ei.value.__cause__, ServingError)
+    assert "crc32" in str(ei.value.__cause__)
+    assert rep.version == committed and pool.model_version == v_model
+    assert REGISTRY.counter("fault_injected_total", kind="corrupt",
+                            site="online_export").value >= 1
+
+    rec = pub.publish()                    # fault spent: publish resumes
+    assert rec["version"] == t.version and rep.version == t.version
+    assert pool.model_version == v_model + 1
+
+
+# -------------------------------------------- save() drains async pushes --
+
+def test_save_drains_inflight_async_apply_before_snapshot(tmp_path):
+    """Satellite regression: save() must not snapshot while an async
+    push is mid-apply -- the drain barrier holds it until the row is
+    fully applied (gated worker thread)."""
+    t = _fresh_table("drain_tbl", vocab=8, dim=2,
+                     initializer=np.zeros((8, 2), np.float32),
+                     async_updates=True)
+    gate, entered = threading.Event(), threading.Event()
+    orig = ht.HostTable._apply
+
+    def gated(ids, grads):
+        entered.set()
+        assert gate.wait(10), "test gate never opened"
+        return orig(t, ids, grads)
+
+    try:
+        t._apply = gated
+        t.push(np.array([3]), np.ones((1, 2), np.float32))
+        assert entered.wait(5)
+        done = threading.Event()
+        th = threading.Thread(
+            target=lambda: (t.save(str(tmp_path)), done.set()), daemon=True)
+        th.start()
+        assert not done.wait(0.25), \
+            "save() snapshotted past an in-flight async apply"
+        gate.set()
+        assert done.wait(10)
+        th.join(5)
+        data = np.load(t._ckpt_path(str(tmp_path)))
+        assert int(data["meta"][1]) == 1          # the push made the cut
+        np.testing.assert_allclose(data["table"][3], -1.0)
+    finally:
+        gate.set()
+        ht.drop_table("drain_tbl")
+
+
+# ------------------------------------------------------------- SLO rule ---
+
+def test_model_freshness_slo_rule_on_the_real_gauge(model):
+    """Satellite: examples/slo_rules.json's model-freshness rule against
+    the real model_staleness_seconds gauge -- no-data never false-fires,
+    an aged hermetic pool fires, a delta publish resolves."""
+    rules = [r for r in slo.load_rules(
+        os.path.join(REPO, "examples", "slo_rules.json"))
+        if r.id == "model-freshness"]
+    assert rules, "examples/slo_rules.json lost the model-freshness rule"
+
+    # no data: a registry without the gauge must stay silent
+    eng0 = slo.SLOEngine(rules, registry=MetricsRegistry())
+    assert eng0.evaluate(now=0.0) == []
+
+    clock = FakeClock()
+    pool = _pool(model, size=1, clock=clock)
+    eng = slo.SLOEngine(rules, registry=REGISTRY)
+    assert all(a.rule != "model-freshness" for a in eng.evaluate(now=0.0))
+
+    clock.advance(4000.0)                  # objective is <= 3600 seconds
+    assert any(a.rule == "model-freshness" for a in eng.evaluate(now=1.0))
+
+    t = model.table
+    t.arm_publisher()
+    since = pool.sparse_tables[model.name].version
+    _push(t, [6], seed=51)
+    pool.apply_delta(t.export_delta(since))
+    assert pool.model_staleness_seconds() == 0.0
+    assert all(a.rule != "model-freshness" for a in eng.evaluate(now=2.0))

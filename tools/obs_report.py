@@ -465,6 +465,89 @@ def render_ingestion(events: Optional[List[dict]],
     return "\n".join(lines)
 
 
+# ----------------------------------------------------------------- online --
+
+def render_online(events: Optional[List[dict]],
+                  snapshot: Optional[dict] = None) -> str:
+    """Online-learning activity (paddle_tpu/online/): delta publishes from
+    the trainer-side ``OnlinePublisher`` (``online_publish`` events +
+    ``delta_rows_total``/``delta_bytes_total``), serving-side partial
+    applies (``online_apply``), publish wall time and model staleness."""
+    lines = ["== Online learning =="]
+    events = events or []
+    pubs = [e for e in events if e.get("event") == "online_publish"]
+    apps = [e for e in events if e.get("event") == "online_apply"]
+    fams = {f.get("name"): f for f in (snapshot or {}).get("families", [])}
+    if not pubs and not apps and "online_publish_total" not in fams \
+            and "delta_bytes_total" not in fams:
+        lines.append("idle: no online-learning activity (arm a "
+                     "paddle_tpu.online.OnlinePublisher or run "
+                     "bench_online.py)")
+        return "\n".join(lines)
+    ok = [e for e in pubs if e.get("outcome") == "ok"]
+    err = [e for e in pubs if e.get("outcome") == "error"]
+    empty = [e for e in pubs if e.get("outcome") == "empty"]
+    c_ok = c_err = 0.0
+    for s in fams.get("online_publish_total", {}).get("samples", []):
+        if s.get("labels", {}).get("outcome") == "ok":
+            c_ok += s.get("value", 0.0)
+        elif s.get("labels", {}).get("outcome") == "error":
+            c_err += s.get("value", 0.0)
+    lines.append(f"publishes: {c_ok if c_ok else len(ok):g} ok, "
+                 f"{c_err if c_err else len(err):g} failed"
+                 + (f", {len(empty)} empty" if empty else ""))
+    rows_t = _counter_total(snapshot, "delta_rows_total")
+    bytes_t = _counter_total(snapshot, "delta_bytes_total")
+    if rows_t is None and ok:
+        rows_t = float(sum(int(e.get("rows") or 0) for e in ok))
+        bytes_t = float(sum(int(e.get("bytes") or 0) for e in ok))
+    if rows_t is not None:
+        lines.append(f"delta rows shipped: {rows_t:g} "
+                     f"({(bytes_t or 0.0):g} bytes on wire)")
+    for e in ok[-3:]:
+        full = ", full" if e.get("full") else ""
+        lines.append(f"  PUBLISH {e.get('table')} -> table version "
+                     f"{e.get('version')} ({e.get('rows')} rows, "
+                     f"{e.get('bytes')} bytes, {e.get('encoding')}{full}) "
+                     f"in {e.get('publish_ms')}ms")
+    for e in err[-3:]:
+        lines.append(f"  PUBLISH FAILED seq {e.get('seq')}: "
+                     f"{str(e.get('error', ''))[:90]}")
+    a_ok = [e for e in apps if e.get("outcome") == "ok"]
+    a_rej = [e for e in apps if e.get("outcome") == "rejected"]
+    c_aok = c_arej = 0.0
+    for s in fams.get("online_apply_total", {}).get("samples", []):
+        if s.get("labels", {}).get("outcome") == "ok":
+            c_aok += s.get("value", 0.0)
+        elif s.get("labels", {}).get("outcome") == "rejected":
+            c_arej += s.get("value", 0.0)
+    if apps or "online_apply_total" in fams:
+        lines.append(f"serving applies: {c_aok if c_aok else len(a_ok):g} "
+                     f"ok, {c_arej if c_arej else len(a_rej):g} rejected")
+        for e in a_ok[-3:]:
+            lines.append(f"  APPLY {e.get('table')} -> model_version "
+                         f"{e.get('model_version')} (table version "
+                         f"{e.get('table_version')}) in "
+                         f"{e.get('apply_ms')}ms")
+        for e in a_rej[-3:]:
+            lines.append(f"  APPLY REJECTED (old version keeps serving): "
+                         f"{str(e.get('error', ''))[:90]}")
+    for s in fams.get("online_publish_seconds", {}).get("samples", []):
+        n = s.get("count", 0)
+        if not n:
+            continue
+        p50 = _hist_quantile(s.get("buckets", []), 0.5)
+        p99 = _hist_quantile(s.get("buckets", []), 0.99)
+        fmt = lambda v: ("?" if v is None else "inf" if math.isinf(v)
+                         else f"{v * 1e3:.4g}ms")
+        mean = s.get("sum", 0.0) / n
+        lines.append(f"publish wall: n={n} mean={mean * 1e3:.4g}ms "
+                     f"p50<={fmt(p50)} p99<={fmt(p99)}")
+    for s in fams.get("model_staleness_seconds", {}).get("samples", []):
+        lines.append(f"model staleness now: {s.get('value', 0.0):g}s")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- megastep --
 
 def _counter_total(snapshot: Optional[dict], name: str) -> Optional[float]:
@@ -860,6 +943,7 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_checkpoint(events, snapshot))
         parts.append(render_serving(events, snapshot))
         parts.append(render_ingestion(events, snapshot))
+        parts.append(render_online(events, snapshot))
         parts.append(render_alerts(events, snapshot))
     if bench_summary is not None or snapshot is not None or events:
         parts.append(render_attribution(events, snapshot, bench_summary))
@@ -949,6 +1033,16 @@ def selftest() -> int:
     reg.gauge("stream_buffer_depth").set(7)
     for v in (0.003, 0.005, 0.011):
         reg.histogram("sample_age_seconds").observe(v)
+    # online-learning section sources (paddle_tpu/online/, ISSUE 19)
+    reg.counter("delta_rows_total", table="emb").inc(128)
+    reg.counter("delta_bytes_total", table="emb").inc(4096)
+    reg.counter("online_publish_total", outcome="ok").inc(3)
+    reg.counter("online_publish_total", outcome="error").inc()
+    reg.counter("online_apply_total", outcome="ok").inc(3)
+    reg.counter("online_apply_total", outcome="rejected").inc()
+    for v in (0.004, 0.006, 0.011):
+        reg.histogram("online_publish_seconds").observe(v)
+    reg.gauge("model_staleness_seconds").set(2.5)
     # alerts & post-mortem sources (observability/slo.py + blackbox.py)
     reg.counter("alerts_total", rule="training-goodput",
                 severity="page").inc(2)
@@ -1058,6 +1152,21 @@ def selftest() -> int:
          "ts": 9.965},
         {"event": "stream_epoch", "batches": 12, "records": 36,
          "dead_letters": 3, "sources": {"clicks": 2048}, "ts": 9.966},
+        # online-learning section (paddle_tpu/online/, ISSUE 19)
+        {"event": "online_publish", "outcome": "ok", "table": "emb",
+         "seq": 3, "version": 42, "rows": 64, "bytes": 2048,
+         "full": False, "encoding": "int8", "publish_ms": 5.2,
+         "ts": 9.967},
+        {"event": "online_publish", "outcome": "error", "table": "emb",
+         "seq": 4, "since": 42,
+         "error": "delta apply rejected: chunk 0: crc32 mismatch",
+         "ts": 9.968},
+        {"event": "online_apply", "outcome": "ok", "table": "emb",
+         "model_version": 5, "table_version": 42, "rows": 64,
+         "apply_ms": 1.3, "ts": 9.969},
+        {"event": "online_apply", "outcome": "rejected", "table": "emb",
+         "error": "chunk 0: crc32 mismatch (torn or bit-flipped payload)",
+         "ts": 9.9695},
         # alerts & post-mortem section (ISSUE 17)
         {"event": "slo_armed", "rules": ["training-goodput",
                                         "serving-latency-p99"],
@@ -1195,6 +1304,21 @@ def selftest() -> int:
                      "['part-00007.txt']",
                      "sample freshness: n=3",
                      "buffer depth now: 7",
+                     # online-learning section (ISSUE 19)
+                     "== Online learning ==",
+                     "publishes: 3 ok, 1 failed",
+                     "delta rows shipped: 128 (4096 bytes on wire)",
+                     "PUBLISH emb -> table version 42 (64 rows, 2048 "
+                     "bytes, int8) in 5.2ms",
+                     "PUBLISH FAILED seq 4: delta apply rejected: "
+                     "chunk 0: crc32 mismatch",
+                     "serving applies: 3 ok, 1 rejected",
+                     "APPLY emb -> model_version 5 (table version 42) "
+                     "in 1.3ms",
+                     "APPLY REJECTED (old version keeps serving): "
+                     "chunk 0: crc32 mismatch",
+                     "publish wall: n=3",
+                     "model staleness now: 2.5s",
                      # alerts & post-mortem section (ISSUE 17)
                      "== Alerts & post-mortems ==",
                      "SLO engine armed: 2 rule(s) [training-goodput, "
@@ -1243,6 +1367,7 @@ def selftest() -> int:
         assert "quiet" in render_checkpoint([])
         assert "idle" in render_serving([])
         assert "quiet" in render_ingestion([])
+        assert "idle" in render_online([])
         assert "unfused" in render_megastep([])
         assert "(no trace events)" in render_timeline([])
         assert "no memory samples" in render_memory({"families": []})

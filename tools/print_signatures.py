@@ -37,6 +37,7 @@ MODULES = [
     "paddle_tpu.resilience",
     "paddle_tpu.data",
     "paddle_tpu.observability",
+    "paddle_tpu.online",
     "paddle_tpu.serving",
     "paddle_tpu.utils.checkpointer",
     "tools.ckpt_doctor",
