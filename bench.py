@@ -410,6 +410,193 @@ def bench_comm_sweep(sizes_mb=(1, 4, 16, 64, 256),
     return doc
 
 
+# --------------------------------------------------------------- warm store --
+
+_WARMSTORE_CHILD = r'''
+"""Warm-store bench child: one fresh process = one leg.
+
+Trains a small fc net (startup + main program compiles), saves it, and
+serves one Predictor request -- timing the first step and the serving
+cold start, then reporting the warm-store counters so the parent can
+tell a compile from a restore.  The store root arrives via
+PADDLE_TPU_WARMSTORE in the environment; argv[1] is a scratch dir.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+workdir = sys.argv[1]
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.data("x", [16], "float32")
+    label = fluid.data("label", [1], "float32")
+    h = fluid.layers.fc(x, 32, act="relu")
+    y = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square(y - label))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+main.random_seed = 7
+
+rng = np.random.RandomState(0)
+feed = {"x": rng.randn(8, 16).astype("float32"),
+        "label": rng.randn(8, 1).astype("float32")}
+exe = fluid.Executor()
+model_dir = os.path.join(workdir, "model")
+with fluid.scope_guard(fluid.Scope()):
+    exe.run(startup)
+    t0 = time.perf_counter()
+    first = exe.run(main, feed=feed, fetch_list=[loss.name])[0]
+    t_first_step = time.perf_counter() - t0
+    losses = [float(np.asarray(first))]
+    for _ in range(2):
+        losses.append(float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss.name])[0])))
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe, main)
+
+t0 = time.perf_counter()
+pred = fluid.inference.Predictor(model_dir)
+out, = pred.run({"x": feed["x"]})
+t_first_predict = time.perf_counter() - t0
+
+import paddle_tpu.warmstore as ws  # noqa: E402
+
+ws.flush()
+from paddle_tpu.observability.metrics import REGISTRY  # noqa: E402
+
+
+def _total(name, **match):
+    fam = REGISTRY.get(name)
+    if not fam:
+        return 0
+    tot = 0
+    for lbl, c in fam.items():
+        lbl = dict(lbl)
+        if any(lbl.get(k) != v for k, v in match.items()):
+            continue
+        v = getattr(c, "count", None)
+        if v is None:
+            v = getattr(c, "value", 0)
+        tot += int(v or 0)
+    return tot
+
+
+print(json.dumps({
+    "t_first_step": t_first_step,
+    "t_first_predict": t_first_predict,
+    "executor_compiles": _total("executor_compile_seconds"),
+    "warm_restores": _total("warmstore_restore_seconds"),
+    "ws_hits": _total("warmstore_hits_total"),
+    "ws_tier_b_hits": _total("warmstore_hits_total", tier="b"),
+    "ws_misses": _total("warmstore_misses_total"),
+    "losses": losses,
+    "out_sum": float(np.asarray(out).sum()),
+}), flush=True)
+'''
+
+
+def bench_warmstore(out_path="BENCH_WARMSTORE_r01.json"):
+    """Warm-start measurement: two identical processes share one store.
+    Process A (cold) populates it -- every program is a compile miss;
+    process B (warm) must compile strictly fewer programs (tier-B hits
+    on the train step, the fused startup, and the Predictor signature)
+    and see a smaller first-step wall.  Rows land in ``out_path`` for
+    the bench trajectory sentinel (BENCH_WARMSTORE_r*.json)."""
+    import subprocess
+    import tempfile
+    here = os.path.dirname(os.path.abspath(__file__))
+    kind = None
+    results = {}
+    with tempfile.TemporaryDirectory(prefix="paddle_tpu_ws_bench_") as td:
+        store = os.path.join(td, "store")
+        child = os.path.join(td, "child.py")
+        with open(child, "w") as f:
+            f.write(_WARMSTORE_CHILD)
+        for leg in ("cold", "warm"):
+            workdir = os.path.join(td, leg)
+            os.makedirs(workdir)
+            env = dict(os.environ, PADDLE_TPU_WARMSTORE=store,
+                       JAX_PLATFORMS="cpu",
+                       PYTHONPATH=here + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+            t0 = time.perf_counter()
+            p = subprocess.run([sys.executable, child, workdir],
+                               capture_output=True, text=True, env=env,
+                               timeout=600)
+            wall = time.perf_counter() - t0
+            if p.returncode != 0:
+                return {"error": f"warm-store {leg} leg failed "
+                                 f"(rc {p.returncode}): {p.stderr[-800:]}"}
+            doc = json.loads(p.stdout.strip().splitlines()[-1])
+            doc["process_wall_seconds"] = round(wall, 3)
+            results[leg] = doc
+    import jax
+    kind = jax.devices()[0].device_kind
+    cold, warm = results["cold"], results["warm"]
+    identical = cold["losses"] == warm["losses"] and \
+        cold["out_sum"] == warm["out_sum"]
+    rows = [
+        {"metric": "warmstore_cold_first_step_wall_seconds",
+         "value": round(cold["t_first_step"], 4),
+         "unit": "s (process A: first train step, compile miss)",
+         "executor_compiles": cold["executor_compiles"],
+         "device_kind": kind},
+        {"metric": "warmstore_warm_first_step_wall_seconds",
+         "value": round(warm["t_first_step"], 4),
+         "unit": "s (process B: first train step, store restore)",
+         "speedup_vs_cold": round(
+             cold["t_first_step"] / warm["t_first_step"], 2)
+         if warm["t_first_step"] else None,
+         "device_kind": kind},
+        {"metric": "warmstore_cold_first_predict_wall_seconds",
+         "value": round(cold["t_first_predict"], 4),
+         "unit": "s (process A: Predictor load + first run, AOT compile)",
+         "device_kind": kind},
+        {"metric": "warmstore_warm_first_predict_wall_seconds",
+         "value": round(warm["t_first_predict"], 4),
+         "unit": "s (process B: Predictor load + first run, store "
+                 "restore)",
+         "speedup_vs_cold": round(
+             cold["t_first_predict"] / warm["t_first_predict"], 2)
+         if warm["t_first_predict"] else None,
+         "device_kind": kind},
+        {"metric": "warmstore_warm_tier_hits",
+         "value": warm["ws_hits"],
+         "unit": "store hits in process B (tier b on this build)",
+         "tier_b": warm["ws_tier_b_hits"],
+         "cold_hits": cold["ws_hits"],
+         "cold_misses": cold["ws_misses"],
+         "device_kind": kind},
+        {"metric": "warmstore_warm_executor_compile_count",
+         "value": warm["executor_compiles"],
+         "unit": "fresh executor compiles in process B (cold compiled "
+                 "strictly more)",
+         "cold_compiles": cold["executor_compiles"],
+         "warm_restores": warm["warm_restores"],
+         "outputs_byte_identical": identical,
+         "device_kind": kind},
+    ]
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    doc = {"rows": rows, "cold": cold, "warm": warm}
+    if warm["executor_compiles"] >= cold["executor_compiles"]:
+        doc["error"] = (f"warm leg did not compile strictly fewer "
+                        f"programs ({warm['executor_compiles']} vs "
+                        f"{cold['executor_compiles']})")
+    elif not identical:
+        doc["error"] = "warm-leg outputs differ from cold-leg outputs"
+    if out_path and "error" not in doc:
+        with open(out_path, "w") as f:
+            json.dump(rows, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[bench] warm-store round written to {out_path}",
+              file=sys.stderr)
+    return doc
+
+
 def bench_checkpoint(n_saves=4, width=1024):
     """Save-stall microbench: blocked time per checkpoint save with async
     off vs on (ISSUE 9 acceptance).  Sync saves block the training loop
@@ -582,6 +769,16 @@ def _parse_args(argv=None):
                          "(default BENCH_COMM_r01.json); needs >=2 "
                          "devices -- on a CPU host export XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8 first")
+    ap.add_argument("--warm-store", metavar="PATH", nargs="?",
+                    const="BENCH_WARMSTORE_r01.json", default=None,
+                    help="run ONLY the warm-start measurement: two "
+                         "identical processes share one "
+                         "PADDLE_TPU_WARMSTORE store; the cold leg "
+                         "populates it, the warm leg must compile "
+                         "strictly fewer programs (tier-B hits on the "
+                         "train step and Predictor signature) with "
+                         "byte-identical outputs; rows go to PATH "
+                         "(default BENCH_WARMSTORE_r01.json)")
     ap.add_argument("--comm-sweep-sizes", default=None,
                     help="comma-separated MB sizes for --comm-sweep "
                          "(default 1,4,16,64,256)")
@@ -604,6 +801,12 @@ def _parse_args(argv=None):
 
 if __name__ == "__main__":
     _args = _parse_args()
+    if _args.warm_store:
+        _doc = bench_warmstore(out_path=_args.warm_store)
+        if "error" in _doc:
+            print(f"[bench] warm-store FAILED: {_doc['error']}",
+                  file=sys.stderr)
+        sys.exit(2 if "error" in _doc else 0)
     if _args.comm_sweep:
         _sizes = tuple(int(s) for s in _args.comm_sweep_sizes.split(",")) \
             if _args.comm_sweep_sizes else (1, 4, 16, 64, 256)

@@ -16,7 +16,10 @@ no new hot-path timers -- and classifies the wall-clock window into:
   device computes *through* that wait, so counting it lost would misread
   an efficient run as idle).  Pass ``count_sync_as_productive=False`` for
   the strict async-dispatch reading where every host sync is overhead.
-- **named loss causes**: ``compile``, ``verify`` (static analysis at
+- **named loss causes**: ``compile``, ``warm_restore`` (compile misses
+  served from the warm-start store -- still lost time, but split out so
+  a warm fleet's ledger shows restores shrinking where compiles were),
+  ``verify`` (static analysis at
   compile-miss time), ``autotune`` (empirical search), ``feed_prep``
   (host feed staging), ``feed_wait`` (prefetch stalls), ``telemetry``
   (journal writes), ``checkpoint`` (save-blocked time), ``retry_backoff``,
@@ -51,7 +54,8 @@ from .metrics import REGISTRY, MetricsRegistry
 PRODUCTIVE_CAUSES = ("dispatch", "fetch_sync")
 
 #: every named bucket the ledger can attribute seconds to, in report order
-CAUSES = ("dispatch", "fetch_sync", "compile", "verify", "autotune",
+CAUSES = ("dispatch", "fetch_sync", "compile", "warm_restore", "verify",
+          "autotune",
           "feed_prep", "feed_wait", "telemetry", "checkpoint",
           "retry_backoff", "skipped_steps", "rollback", "elastic_restart",
           "other")
@@ -66,6 +70,7 @@ _PHASE_CAUSE = {
     ("feed_prep", "executor"): "feed_prep",
     ("journal", "executor"): "telemetry",
     ("compile", "executor"): "compile",
+    ("warm_restore", "executor"): "warm_restore",
     ("verify", "executor"): "verify",
     ("feed_wait", "dataset"): "feed_wait",
 }

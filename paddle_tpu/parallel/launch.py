@@ -231,6 +231,16 @@ def _launch_once(nproc, script_argv, coordinator, devices_per_proc, log_dir,
     endpoints = ",".join(eps)
     log_dir = log_dir or os.path.join(os.getcwd(), "launch_logs")
     os.makedirs(log_dir, exist_ok=True)
+    if os.environ.get("PADDLE_TPU_WARMSTORE"):
+        # armed warm store: one directory scan in the launcher warms the
+        # OS page cache for every rank about to consult the store (ranks
+        # all read the same root; rank 0 is the only writer). Env checked
+        # before the import -- a disarmed launch never loads the package.
+        try:
+            from .. import warmstore as _ws
+            _ws.prefetch()
+        except Exception:
+            pass
     procs, logs = [], []
     for rank in range(nproc):
         env = dict(os.environ)

@@ -89,7 +89,7 @@ ENV_VAR = "PADDLE_TPU_FAULTS"
 KINDS = ("nan", "exc", "hang", "preempt", "kill", "corrupt", "truncate")
 SITES = ("compile", "dispatch", "fetch", "checkpoint_write",
          "serve_dispatch", "serve_fetch", "serve_hang", "read", "parse",
-         "online_export")
+         "online_export", "warmstore_write")
 #: sites fired from the serving tier (PredictorPool workers); ``var`` at
 #: these sites names a tenant, not a tensor
 SERVING_SITES = ("serve_dispatch", "serve_fetch", "serve_hang")
@@ -557,6 +557,58 @@ def mutate_checkpoint(dirname, step: Optional[int] = None) -> List[dict]:
         _journal.emit({"event": "ckpt_fault", "kind": f.kind,
                        "file": str(victim), "step": step,
                        "detail": detail})
+    return applied
+
+
+def mutate_warmstore(entry_dir) -> List[dict]:
+    """Hook point: apply armed ``corrupt``/``truncate`` faults to a
+    warm-store entry the writer thread just committed under
+    ``entry_dir`` (the chaos half of the warm-start contract: consult
+    must catch the damage via crc32/size, quarantine the entry
+    ``.corrupt``, and fall through to a fresh compile -- a bad store can
+    never fail a step).  Same damage grammar as ``mutate_checkpoint``:
+    one seeded bit flip (size unchanged, only crc32 catches it) or a cut
+    to half the bytes.  meta.json is never the victim directly -- the
+    payload tiers are what the read-side checksums guard."""
+    if not _active:
+        return []
+    from ..utils import fs as _fsio
+    applied = []
+    for f in _active:
+        if f.kind not in ("corrupt", "truncate") or \
+                not f.matches("warmstore_write", None):
+            continue
+        try:
+            names = sorted(n for n in _fsio.listdir(entry_dir)
+                           if n.startswith("tier_"))
+        except OSError:
+            names = []
+        if not names:
+            f.missed += 1
+            if f.missed == 1:
+                _journal.emit({"event": "fault_miss", "kind": f.kind,
+                               "var": f.var,
+                               "detail": f"no payload to {f.kind} in "
+                                         f"{entry_dir}"})
+            continue
+        victim = _fsio.join(entry_dir, names[f._rng.randrange(len(names))])
+        data = _fsio.read_bytes(victim)
+        if not data:
+            continue
+        if f.kind == "corrupt":
+            pos = f._rng.randrange(len(data))
+            mutated = (data[:pos] + bytes([data[pos] ^ 0x01]) +
+                       data[pos + 1:])
+            detail = f"bit-flip at byte {pos}"
+        else:
+            mutated = data[:max(1, len(data) // 2)]
+            detail = f"truncated {len(data)} -> {len(mutated)} bytes"
+        _fsio.write_bytes(victim, mutated)
+        _record(f, "warmstore_write", None, var=f.var)
+        applied.append({"kind": f.kind, "file": str(victim),
+                        "detail": detail})
+        _journal.emit({"event": "warmstore_fault", "kind": f.kind,
+                       "file": str(victim), "detail": detail})
     return applied
 
 

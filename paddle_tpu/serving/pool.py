@@ -851,6 +851,17 @@ class PredictorPool:
         pool would serve that bucket in -- so no served request ever pays
         an XLA compile. Returns the number of (predictor, bucket) pairs
         warmed."""
+        import os
+        if os.environ.get("PADDLE_TPU_WARMSTORE"):
+            # armed warm store: pay its one startup directory scan here
+            # so every per-bucket compile below consults a warm page
+            # cache (each Predictor._executable miss then restores
+            # instead of compiling; env checked before the import)
+            try:
+                from .. import warmstore as _ws
+                _ws.prefetch()
+            except Exception:
+                pass
         probe = Request(feed)
         if buckets is None:
             cap = _choices.pow2_bucket(self._batcher.max_batch)

@@ -1,0 +1,76 @@
+"""Warm-start store: persistent compiled-artifact cache across restarts,
+elastic resizes, and the serving pool (ISSUE 20).
+
+Armed by pointing ``PADDLE_TPU_WARMSTORE`` at a directory; unset means
+fully disarmed -- call sites in the executor / predictor / launch check
+the environment variable BEFORE importing this package, so a disarmed
+process never pays an import, an open, a thread, or a probe subprocess
+(the zero-overhead guard is pinned by asserting ``paddle_tpu.warmstore``
+never enters ``sys.modules``).
+
+Two artifact tiers per entry -- see ``store.py`` (layout, write/read
+discipline) and ``probe.py`` (why tier A is gated per build).  Keying is
+in ``keys.py``; the CLI (``python -m paddle_tpu.warmstore``) in
+``__main__.py``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .keys import build_key, digest, program_digest  # noqa: F401
+from .store import Hit, WarmStore  # noqa: F401
+
+ENV = "PADDLE_TPU_WARMSTORE"
+
+_lock = threading.Lock()
+_store: Optional[WarmStore] = None
+_store_root: Optional[str] = None
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(ENV))
+
+
+def root() -> Optional[str]:
+    return os.environ.get(ENV) or None
+
+
+def active_store() -> Optional[WarmStore]:
+    """The process singleton for the armed root, or None when disarmed.
+    Re-pointing the env var (tests) transparently swaps the instance."""
+    global _store, _store_root
+    r = root()
+    if not r:
+        return None
+    with _lock:
+        if _store is None or _store_root != r:
+            if _store is not None:
+                _store.close()
+            _store = WarmStore(r)
+            _store_root = r
+        return _store
+
+
+def prefetch() -> int:
+    """One startup directory scan (the launch/warmup prefetch door).
+    Disarmed: does nothing, returns 0."""
+    s = active_store()
+    return s.prefetch() if s is not None else 0
+
+
+def flush(timeout: float = 30.0) -> bool:
+    s = active_store()
+    return True if s is None else s.flush(timeout)
+
+
+def reset_for_tests():
+    global _store, _store_root
+    from . import probe as _probe
+    with _lock:
+        if _store is not None:
+            _store.close()
+        _store = None
+        _store_root = None
+    _probe.reset_for_tests()

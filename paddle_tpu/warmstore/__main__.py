@@ -1,0 +1,194 @@
+"""CLI for the warm-start store.
+
+    python -m paddle_tpu.warmstore [--root DIR] ls
+    python -m paddle_tpu.warmstore [--root DIR] verify      # rc 1 on damage
+    python -m paddle_tpu.warmstore [--root DIR] gc --max-bytes N
+    python -m paddle_tpu.warmstore [--root DIR] prefetch
+    python -m paddle_tpu.warmstore --selftest               # hermetic
+
+``--root`` defaults to ``PADDLE_TPU_WARMSTORE``.  ``verify`` exits
+nonzero when any entry fails its checksum -- that is the hook
+``tools/ci_lint.py`` drives over a planted store.  ``--selftest`` builds
+a temp store and exercises both probe verdicts (forced pass: tier-A
+round trip executes byte-identical; forced fail: the same entry serves
+tier B and the serialized-executable path is never touched), plus
+corrupt-entry quarantine and gc -- no network, no persistent state.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _store(root):
+    if not root:
+        print("error: no store root (pass --root or set "
+              "PADDLE_TPU_WARMSTORE)", file=sys.stderr)
+        return None
+    from .store import WarmStore
+    return WarmStore(root)
+
+
+def _cmd_ls(store) -> int:
+    rows = store.ls()
+    for r in rows:
+        mark = "CORRUPT " if r["corrupt"] else ""
+        tiers = "".join(r["tiers"]) or "-"
+        print(f"{r['digest']}  {mark}kind={r['kind'] or '-'} "
+              f"tiers={tiers} bytes={r['bytes']}")
+    print(f"{len(rows)} entries, "
+          f"{sum(r['bytes'] for r in rows)} bytes")
+    return 0
+
+
+def _cmd_verify(store) -> int:
+    problems = store.verify()
+    for p in problems:
+        print(f"BAD {p}")
+    print(f"verify: {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+def _cmd_gc(store, max_bytes: int) -> int:
+    removed = store.gc(max_bytes)
+    for name in removed:
+        print(f"evicted {name}")
+    print(f"gc: removed {len(removed)} entries")
+    return 0
+
+
+def _cmd_prefetch(store) -> int:
+    n = store.prefetch()
+    print(f"prefetch: {n} entries readable")
+    return 0
+
+
+def selftest() -> int:
+    """Hermetic end-to-end check with fake probe verdicts both ways."""
+    import pickle
+    import tempfile
+    import warnings
+    failures = []
+
+    def check(name, cond):
+        print(f"{'ok' if cond else 'FAIL'}: {name}")
+        if not cond:
+            failures.append(name)
+
+    with tempfile.TemporaryDirectory(prefix="paddle_tpu_ws_self_") as td:
+        os.environ["PADDLE_TPU_WARMSTORE_PROBE"] = "pass"
+        from . import probe as _probe
+        from .store import WarmStore
+        _probe.reset_for_tests()
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import serialize_executable as se
+        import jax.export as jexport
+
+        def f(x):
+            return jnp.tanh(x) * 2.0 + 1.0
+
+        x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        comp = jax.jit(f).lower(x).compile()
+        ref = np.asarray(comp(x))
+        aval = jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        store = WarmStore(os.path.join(td, "store"))
+        key = {"kind": "selftest", "n": 1}
+        store.offer(
+            key,
+            tier_a_build=lambda: pickle.dumps(se.serialize(comp)),
+            tier_b_build=lambda: jexport.export(jax.jit(f))(
+                aval).serialize())
+        check("offer flushed", store.flush(30.0))
+
+        hit = store.consult(key)
+        check("forced-pass hit is tier A",
+              hit is not None and hit.tier == "a")
+        if hit is not None and hit.tier == "a":
+            check("tier-A output byte-identical",
+                  np.array_equal(np.asarray(hit.value(x)), ref))
+
+        # same entry under a failing verdict: tier B serves, executable
+        # deserialization is never invoked, and the warning fires once
+        os.environ["PADDLE_TPU_WARMSTORE_PROBE"] = "fail"
+        _probe.reset_for_tests()
+        deser_calls = []
+        real = se.deserialize_and_load
+        se.deserialize_and_load = lambda *a, **k: (
+            deser_calls.append(1), real(*a, **k))[1]
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                hit_b = store.consult(key)
+                store.consult(key)
+            check("forced-fail hit is tier B",
+                  hit_b is not None and hit_b.tier == "b")
+            check("tier A never deserialized", not deser_calls)
+            check("self-disable warns exactly once",
+                  sum("tier A" in str(w.message) for w in caught) == 1)
+        finally:
+            se.deserialize_and_load = real
+        if hit_b is not None and hit_b.tier == "b":
+            out_b = jax.jit(hit_b.value.call).lower(x).compile()(x)
+            check("tier-B output byte-identical",
+                  np.array_equal(np.asarray(out_b), ref))
+        check("probe spawned no subprocess", _probe.SPAWNS == 0)
+
+        # corrupt a payload: read side must quarantine and miss clean
+        import glob
+        entry = glob.glob(os.path.join(td, "store", "entries", "*"))[0]
+        victim = os.path.join(entry, "tier_b.bin")
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0x01
+        open(victim, "wb").write(bytes(data))
+        check("corrupt entry misses", store.consult(key) is None)
+        check("corrupt entry quarantined",
+              glob.glob(os.path.join(td, "store", "entries",
+                                     "*.corrupt")) != [])
+        check("verify reports quarantine",
+              any("quarantined" in p for p in store.verify()))
+        store.gc(0)
+        check("gc empties the store", store.ls() == [])
+        store.close()
+        os.environ.pop("PADDLE_TPU_WARMSTORE_PROBE", None)
+        _probe.reset_for_tests()
+    print(f"warmstore selftest: "
+          f"{'PASS' if not failures else 'FAIL'} "
+          f"({len(failures)} failure(s))")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.warmstore")
+    ap.add_argument("--root", default=os.environ.get(
+        "PADDLE_TPU_WARMSTORE"))
+    ap.add_argument("--selftest", action="store_true")
+    sub = ap.add_subparsers(dest="cmd")
+    sub.add_parser("ls")
+    sub.add_parser("verify")
+    gp = sub.add_parser("gc")
+    gp.add_argument("--max-bytes", type=int, required=True)
+    sub.add_parser("prefetch")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    store = _store(args.root)
+    if store is None:
+        return 2
+    if args.cmd == "ls":
+        return _cmd_ls(store)
+    if args.cmd == "verify":
+        return _cmd_verify(store)
+    if args.cmd == "gc":
+        return _cmd_gc(store, args.max_bytes)
+    if args.cmd == "prefetch":
+        return _cmd_prefetch(store)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,482 @@
+"""The on-disk warm-start store: checksummed, content-addressed, async.
+
+Layout (all under the ``PADDLE_TPU_WARMSTORE`` root)::
+
+    entries/<digest32>/
+        tier_a.pkl    pickled (payload, in_tree, out_tree) from
+                      jax.experimental.serialize_executable -- only
+                      written/read when the probe verdict allows tier A
+        tier_b.bin    jax.export StableHLO blob -- recompiled on load,
+                      safe on every build, still skips trace+lower
+        meta.json     written LAST (the commit point): full key dict,
+                      per-file crc32+size, aval/donation validation info
+    entries/<digest32>.corrupt/   quarantined entries (crc/parse failed)
+    probe/                        cached probe verdicts per build
+    tmp/                          staging for atomic temp+rename writes
+
+Write discipline is the PR-8 checkpoint discipline: every file lands via
+temp + ``utils/fs.replace`` rename, meta.json commits the entry, readers
+ignore meta-less directories.  Reads re-checksum every payload; any
+mismatch or parse failure quarantines the entry (rename to ``.corrupt``)
+and falls through to a fresh compile -- a bad store can never fail a
+step.  Writes happen on a lazy daemon writer thread, off the step path,
+and only on rank 0 (all ranks read; multi-host callers barrier after the
+writer drains).  Chaos coverage: the ``warmstore_write`` fault site
+mutates entries AFTER commit, so the read-side defenses are what the
+chaos suite exercises.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import queue
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from ..observability import journal as _journal
+from ..observability.metrics import REGISTRY as _OBS
+from ..utils import fs as _fsio
+from . import keys as _keys
+from . import probe as _probe
+
+META_FORMAT = 1
+_TIERS = ("tier_a.pkl", "tier_b.bin")
+
+
+class Hit:
+    """A validated store hit. ``tier`` is "a" or "b"; ``value`` is the
+    loaded executable callable (tier A) or the deserialized
+    ``jax.export.Exported`` (tier B, caller recompiles)."""
+
+    __slots__ = ("tier", "value", "meta", "digest")
+
+    def __init__(self, tier: str, value, meta: dict, digest: str):
+        self.tier = tier
+        self.value = value
+        self.meta = meta
+        self.digest = digest
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+class WarmStore:
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.entries_dir = _fsio.join(self.root, "entries")
+        self.probe_dir = _fsio.join(self.root, "probe")
+        self.tmp_dir = _fsio.join(self.root, "tmp")
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._writer: Optional[threading.Thread] = None
+        self._pending = 0
+        self._drained = threading.Condition(self._lock)
+        self._closed = False
+
+    # ------------------------------------------------------------ probe --
+
+    def tier_a_enabled(self) -> bool:
+        """One verdict gates both directions (serialize on offer,
+        deserialize on consult); a failing probe means tier A is never
+        constructed and never loaded, with a one-time warning."""
+        v = _probe.verdict(cache_dir=self.probe_dir)
+        if not v.tier_a:
+            _probe.warn_tier_a_disabled_once(v)
+        return v.tier_a
+
+    # ---------------------------------------------------------- metrics --
+
+    def _hit(self, tier: str, digest: str, kind: str):
+        _OBS.counter("warmstore_hits_total", "warm-store hits by tier",
+                     tier=tier).inc()
+        _journal.emit({"event": "warmstore_hit", "tier": tier,
+                       "digest": digest, "kind": kind})
+
+    def _miss(self, reason: str, digest: str = "", kind: str = ""):
+        _OBS.counter("warmstore_misses_total", "warm-store misses",
+                     reason=reason).inc()
+        _journal.emit({"event": "warmstore_miss", "reason": reason,
+                       "digest": digest, "kind": kind})
+
+    def _update_bytes_gauge(self):
+        try:
+            _OBS.gauge("warmstore_bytes_total",
+                       "bytes on disk under the warm-store root").set(
+                self._du())
+        except Exception:
+            pass
+
+    def _du(self) -> int:
+        total = 0
+        if not os.path.isdir(self.entries_dir):
+            return 0
+        for dirpath, _dirnames, filenames in os.walk(self.entries_dir):
+            for fn in filenames:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, fn))
+                except OSError:
+                    pass
+        return total
+
+    # ------------------------------------------------------------- read --
+
+    def consult(self, key: dict, expect: Optional[dict] = None
+                ) -> Optional[Hit]:
+        """Look up ``key``; validate checksums, key identity, and (when
+        ``expect`` is given) aval/sharding/donation compatibility.  Any
+        inconsistency quarantines the entry and reports a miss -- the
+        caller compiles fresh, exactly as if the store were empty."""
+        digest = _keys.digest(key)
+        kind = str(key.get("kind", ""))
+        entry = os.path.join(self.entries_dir, digest)
+        meta_path = os.path.join(entry, "meta.json")
+        if not os.path.isfile(meta_path):
+            self._miss("absent", digest, kind)
+            return None
+        try:
+            with open(meta_path, "rb") as f:
+                raw = f.read()
+            meta = json.loads(raw.decode("utf-8"))
+            if meta.get("format") != META_FORMAT or \
+                    _keys.canonical(meta.get("key", {})) != \
+                    _keys.canonical(key):
+                self._quarantine(entry, digest, "key mismatch")
+                self._miss("invalid", digest, kind)
+                return None
+        except (OSError, ValueError, UnicodeDecodeError):
+            self._quarantine(entry, digest, "unreadable meta")
+            self._miss("corrupt", digest, kind)
+            return None
+        if expect:
+            rec = meta.get("validate", {})
+            for field, want in expect.items():
+                if rec.get(field) != want:
+                    self._miss("invalid", digest, kind)
+                    return None
+        order = ["tier_a.pkl", "tier_b.bin"] if self.tier_a_enabled() \
+            else ["tier_b.bin"]
+        for fname in order:
+            finfo = meta.get("files", {}).get(fname)
+            if not finfo:
+                continue
+            path = os.path.join(entry, fname)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                self._quarantine(entry, digest, f"{fname} unreadable")
+                self._miss("corrupt", digest, kind)
+                return None
+            if len(blob) != int(finfo.get("size", -1)) or \
+                    _crc(blob) != int(finfo.get("crc32", -1)):
+                self._quarantine(entry, digest, f"{fname} checksum")
+                self._miss("corrupt", digest, kind)
+                return None
+            try:
+                if fname == "tier_a.pkl":
+                    value = self._load_tier_a(blob)
+                    tier = "a"
+                else:
+                    value = self._load_tier_b(blob)
+                    tier = "b"
+            except Exception as e:  # deserialize refused: fall through
+                self._miss("error", digest, kind)
+                _journal.emit({"event": "warmstore_restore_error",
+                               "digest": digest, "file": fname,
+                               "error": f"{type(e).__name__}: {e}"})
+                continue
+            self._hit(tier, digest, kind)
+            return Hit(tier, value, meta, digest)
+        self._miss("absent", digest, kind)
+        return None
+
+    def _load_tier_a(self, blob: bytes):
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = pickle.loads(blob)
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+
+    def _load_tier_b(self, blob: bytes):
+        import jax.export as jexport
+        return jexport.deserialize(blob)
+
+    def _quarantine(self, entry: str, digest: str, why: str):
+        dst = f"{entry}.corrupt"
+        try:
+            if os.path.isdir(dst):
+                _fsio.rmtree(dst)
+            _fsio.move(entry, dst)
+        except OSError:
+            _fsio.rmtree(entry)  # can't rename: drop it outright
+        _OBS.counter("warmstore_quarantined_total",
+                     "entries quarantined as .corrupt").inc()
+        _journal.emit({"event": "warmstore_quarantine", "digest": digest,
+                       "reason": why})
+
+    # ------------------------------------------------------------ write --
+
+    def offer(self, key: dict, *,
+              tier_a_build: Optional[Callable[[], Optional[bytes]]] = None,
+              tier_b_build: Optional[Callable[[], Optional[bytes]]] = None,
+              validate: Optional[dict] = None) -> bool:
+        """Enqueue an entry write.  Builders run on the writer thread
+        (tier B's export re-traces; that cost stays off the step path).
+        The tier-A builder is dropped up front on a failing probe, so a
+        denylisted build never even serializes an executable.  Non-rank-0
+        processes drop the offer (rank0-writes/all-read)."""
+        if self._closed or not self._is_writer_rank():
+            return False
+        if os.path.isdir(os.path.join(self.entries_dir,
+                                      _keys.digest(key))):
+            return False  # already committed; offers are idempotent
+        if tier_a_build is not None and not self.tier_a_enabled():
+            tier_a_build = None
+        if tier_a_build is None and tier_b_build is None:
+            return False
+        with self._lock:
+            self._pending += 1
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._writer_loop, daemon=True,
+                    name="paddle-tpu-warmstore-writer")
+                self._writer.start()
+        self._queue.put((dict(key), tier_a_build, tier_b_build,
+                         dict(validate or {})))
+        return True
+
+    def _is_writer_rank(self) -> bool:
+        try:
+            import jax
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+    def _writer_loop(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            key, a_build, b_build, validate = item
+            try:
+                self._write_entry(key, a_build, b_build, validate)
+            except Exception as e:  # a failed write is a non-event
+                _journal.emit({"event": "warmstore_write_error",
+                               "digest": _keys.digest(key),
+                               "error": f"{type(e).__name__}: {e}"})
+            finally:
+                with self._drained:
+                    self._pending -= 1
+                    if self._pending <= 0:
+                        self._drained.notify_all()
+
+    def _write_entry(self, key: dict, a_build, b_build, validate: dict):
+        digest = _keys.digest(key)
+        final = os.path.join(self.entries_dir, digest)
+        if os.path.isdir(final):
+            return
+        blobs: Dict[str, bytes] = {}
+        for fname, build in (("tier_a.pkl", a_build),
+                             ("tier_b.bin", b_build)):
+            if build is None:
+                continue
+            try:
+                blob = build()
+            except Exception as e:  # unexportable program: skip tier
+                _journal.emit({"event": "warmstore_build_skip",
+                               "digest": digest, "file": fname,
+                               "error": f"{type(e).__name__}: {e}"})
+                blob = None
+            if blob:
+                blobs[fname] = blob
+        if not blobs:
+            return
+        _fsio.makedirs(self.tmp_dir)
+        stage = os.path.join(self.tmp_dir,
+                             f"{digest}.{os.getpid()}.{id(key):x}")
+        _fsio.makedirs(stage)
+        meta = {"format": META_FORMAT, "key": key, "validate": validate,
+                "created_unix": time.time(),
+                "files": {name: {"size": len(blob), "crc32": _crc(blob)}
+                          for name, blob in blobs.items()}}
+        for name, blob in blobs.items():
+            _fsio.write_bytes(os.path.join(stage, name), blob)
+        # meta.json lands inside the staged dir; the dir rename commits
+        _fsio.write_bytes(os.path.join(stage, "meta.json"),
+                          json.dumps(meta, sort_keys=True,
+                                     indent=1).encode("utf-8"))
+        _fsio.makedirs(self.entries_dir)
+        try:
+            _fsio.move(stage, final)
+        except OSError:
+            _fsio.rmtree(stage)  # raced another writer: theirs won
+            return
+        _journal.emit({"event": "warmstore_write", "digest": digest,
+                       "kind": str(key.get("kind", "")),
+                       "files": sorted(blobs),
+                       "bytes": sum(map(len, blobs.values()))})
+        self._update_bytes_gauge()
+        # chaos hook fires AFTER commit: the fault grammar corrupts a
+        # committed entry and the read-side crc/quarantine must catch it
+        try:
+            from ..resilience import faults as _rfaults
+            _rfaults.mutate_warmstore(final)
+        except Exception:
+            pass
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Wait for queued writes to land (tests and multi-host barriers;
+        the step path never calls this)."""
+        deadline = time.monotonic() + timeout
+        with self._drained:
+            while self._pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drained.wait(remaining)
+        return True
+
+    def barrier_after_write(self):
+        """Multi-host: rank 0 drains its writer, then all ranks sync so
+        readers never race a half-written store."""
+        try:
+            import jax
+            if jax.process_count() <= 1:
+                return
+            if jax.process_index() == 0:
+                self.flush()
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("paddle_tpu_warmstore")
+        except Exception:
+            pass
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            writer = self._writer
+        if writer is not None:
+            self._queue.put(None)
+            writer.join(timeout=10.0)
+
+    # ------------------------------------------------------- management --
+
+    def _entry_dirs(self, include_corrupt: bool = False) -> List[str]:
+        if not os.path.isdir(self.entries_dir):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.entries_dir)):
+            if name.endswith(".corrupt") and not include_corrupt:
+                continue
+            p = os.path.join(self.entries_dir, name)
+            if os.path.isdir(p):
+                out.append(p)
+        return out
+
+    def ls(self) -> List[dict]:
+        rows = []
+        for entry in self._entry_dirs(include_corrupt=True):
+            name = os.path.basename(entry)
+            row = {"digest": name, "corrupt": name.endswith(".corrupt"),
+                   "kind": "", "tiers": [], "bytes": 0, "mtime": 0.0}
+            try:
+                row["mtime"] = os.path.getmtime(entry)
+                for fn in os.listdir(entry):
+                    row["bytes"] += os.path.getsize(
+                        os.path.join(entry, fn))
+                meta_path = os.path.join(entry, "meta.json")
+                if os.path.isfile(meta_path):
+                    with open(meta_path) as f:
+                        meta = json.load(f)
+                    row["kind"] = str(meta.get("key", {}).get("kind", ""))
+                    row["tiers"] = sorted(
+                        n.split(".")[0][-1] for n in meta.get("files", {}))
+            except (OSError, ValueError):
+                row["corrupt"] = True
+            rows.append(row)
+        return rows
+
+    def verify(self) -> List[str]:
+        """Re-checksum every committed entry; report (do not quarantine)
+        problems -- the CLI surface behind ``tools/ci_lint.py``."""
+        problems = []
+        for entry in self._entry_dirs(include_corrupt=True):
+            name = os.path.basename(entry)
+            if name.endswith(".corrupt"):
+                problems.append(f"{name}: quarantined")
+                continue
+            meta_path = os.path.join(entry, "meta.json")
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError) as e:
+                problems.append(f"{name}: meta.json unreadable "
+                                f"({type(e).__name__})")
+                continue
+            if meta.get("format") != META_FORMAT:
+                problems.append(f"{name}: meta format "
+                                f"{meta.get('format')!r}")
+                continue
+            if _keys.digest(meta.get("key", {})) != name:
+                problems.append(f"{name}: key does not hash to digest")
+            for fname, finfo in sorted(meta.get("files", {}).items()):
+                path = os.path.join(entry, fname)
+                try:
+                    blob = _fsio.read_bytes(path)
+                except OSError:
+                    problems.append(f"{name}/{fname}: missing")
+                    continue
+                if len(blob) != int(finfo.get("size", -1)):
+                    problems.append(f"{name}/{fname}: size "
+                                    f"{len(blob)} != {finfo.get('size')}")
+                elif _crc(blob) != int(finfo.get("crc32", -1)):
+                    problems.append(f"{name}/{fname}: crc32 mismatch")
+        return problems
+
+    def gc(self, max_bytes: int) -> List[str]:
+        """Evict oldest-first until the store fits ``max_bytes``.
+        Quarantined entries go first regardless of age."""
+        removed = []
+        entries = []
+        for entry in self._entry_dirs(include_corrupt=True):
+            size = 0
+            try:
+                for fn in os.listdir(entry):
+                    size += os.path.getsize(os.path.join(entry, fn))
+                mtime = os.path.getmtime(entry)
+            except OSError:
+                mtime = 0.0
+            corrupt = entry.endswith(".corrupt")
+            entries.append((0 if corrupt else 1, mtime, entry, size))
+        total = sum(e[3] for e in entries)
+        for _prio, _mtime, entry, size in sorted(entries):
+            if total <= max_bytes:
+                break
+            _fsio.rmtree(entry)
+            total -= size
+            removed.append(os.path.basename(entry))
+        if removed:
+            _journal.emit({"event": "warmstore_gc", "removed": removed})
+        self._update_bytes_gauge()
+        return removed
+
+    def prefetch(self) -> int:
+        """Stat + parse every committed meta (one directory scan, warms
+        the page cache for the payloads launch is about to read).
+        Returns the number of readable entries."""
+        n = 0
+        for entry in self._entry_dirs():
+            meta_path = os.path.join(entry, "meta.json")
+            try:
+                with open(meta_path) as f:
+                    json.load(f)
+                n += 1
+            except (OSError, ValueError):
+                continue
+        self._update_bytes_gauge()
+        _journal.emit({"event": "warmstore_prefetch", "entries": n,
+                       "root": self.root})
+        return n

@@ -254,7 +254,8 @@ def lint_imports(roots=("paddle_tpu", "tools")) -> List[str]:
 
 BENCH_ROUND_GLOB = os.path.join(REPO, "BENCH_WORKLOADS_r*.json")
 BENCH_ROUND_GLOBS = (BENCH_ROUND_GLOB,
-                     os.path.join(REPO, "BENCH_AUTOSHARD_r*.json"))
+                     os.path.join(REPO, "BENCH_AUTOSHARD_r*.json"),
+                     os.path.join(REPO, "BENCH_WARMSTORE_r*.json"))
 BENCH_BASELINE = os.path.join(REPO, "tools", "bench_baseline.jsonl")
 
 
@@ -337,6 +338,102 @@ def lint_slo(paths: List[str] = None) -> List[str]:
             continue
         findings.extend(f"{rel}: {p}"
                         for p in slo.validate_rules(doc, known=known))
+    return findings
+
+
+# ------------------------------------------------------------- warm store --
+
+def _plant_warmstore(root: str, entries: int = 2) -> None:
+    """Build a small committed store (tier-B payloads only: no compile,
+    no probe, no subprocess) for the verify gate to chew on."""
+    from paddle_tpu.warmstore import WarmStore
+    ws = WarmStore(root)
+    try:
+        for i in range(entries):
+            key = {"format": 1, "kind": "ci_lint", "n": i}
+            payload = (b"ci-lint warmstore payload %d " % i) * 64
+            ws.offer(key, tier_b_build=lambda p=payload: p,
+                     validate={"avals": "()"})
+        if not ws.flush(30.0):
+            raise RuntimeError("warmstore writer did not drain")
+    finally:
+        ws.close()
+
+
+# driver for both verify legs over one planted store: clean must pass
+# (rc 0), then a one-byte payload flip must fail (rc 1) naming the
+# crc32 -- runs through the real CLI entrypoint either way
+_WARMSTORE_DRIVER = """\
+import glob
+from paddle_tpu.warmstore.__main__ import main
+root = {root!r}
+rc_clean = main(['--root', root, 'verify'])
+victim = sorted(glob.glob(root + '/entries/*/tier_b.bin'))[0]
+blob = bytearray(open(victim, 'rb').read())
+blob[0] ^= 0xFF
+open(victim, 'wb').write(bytes(blob))
+rc_flipped = main(['--root', root, 'verify'])
+print('WARMSTORE-LINT-RCS', rc_clean, rc_flipped)
+"""
+
+
+def _run_warmstore_legs(root: str, via_cli: bool):
+    """Both verify legs -> (rc_clean, rc_flipped, output).  ``via_cli``
+    spawns one real ``python`` (the gate); the selftest runs the same
+    driver in-process (same CLI ``main``, no interpreter spawn)."""
+    code = _WARMSTORE_DRIVER.format(root=root)
+    if via_cli:
+        import subprocess
+        env = dict(os.environ,
+                   PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                       "PYTHONPATH", ""),
+                   JAX_PLATFORMS="cpu")
+        env.pop("PADDLE_TPU_WARMSTORE", None)  # --root is explicit
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, env=env,
+                           cwd=REPO, timeout=300)
+        out = p.stdout + p.stderr
+    else:
+        import contextlib
+        import io
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            exec(compile(code, "<warmstore-lint>", "exec"), {})
+        out = buf.getvalue()
+    for line in out.splitlines():
+        if line.startswith("WARMSTORE-LINT-RCS"):
+            _, rc_clean, rc_flipped = line.rsplit(None, 2)
+            return int(rc_clean), int(rc_flipped), out
+    return None, None, out
+
+
+def lint_warmstore(via_cli: bool = True) -> List[str]:
+    """The warm-start store's integrity surface must work: ``verify``
+    passes a freshly planted store (rc 0) and flags a one-byte payload
+    flip (rc 1, crc32 named).  Detail strings; empty = gate green."""
+    import tempfile
+    findings: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="paddle_tpu_ws_lint_") as td:
+        root = os.path.join(td, "store")
+        try:
+            _plant_warmstore(root)
+        except Exception as e:
+            return [f"could not plant a store: {type(e).__name__}: {e}"]
+        try:
+            rc_clean, rc_flipped, out = _run_warmstore_legs(root, via_cli)
+        except Exception as e:
+            return [f"verify driver crashed: {type(e).__name__}: {e}"]
+        flat = out.strip().replace("\n", " | ")
+        if rc_clean is None:
+            return [f"verify driver emitted no verdict: {flat}"]
+        if rc_clean != 0:
+            findings.append(f"verify flagged a clean planted store "
+                            f"(rc {rc_clean}): {flat}")
+        if rc_flipped == 0:
+            findings.append("verify missed a one-byte payload flip (rc 0)")
+        elif "crc32" not in out:
+            findings.append(f"verify failed the flipped store but did "
+                            f"not name the crc32 mismatch: {flat}")
     return findings
 
 
@@ -470,6 +567,14 @@ def selftest() -> int:
         if not any("goodput_fractoin" in p for p in probs) or \
                 not any("short_s must be < long_s" in p for p in probs):
             failures.append(f"planted bad SLO rules not caught: {probs}")
+    # 8. warm-store gate: the verify CLI passes a planted store and
+    # catches a flipped payload byte (detector armed, surface wired) --
+    # same driver as the gate, in-process (no interpreter spawn; the
+    # real subprocess leg is pinned by the test suite's CLI selftest)
+    wsf = lint_warmstore(via_cli=False)
+    if wsf:
+        failures.append("warm-store verify gate broken:\n  "
+                        + "\n  ".join(wsf))
     if failures:
         print("ci_lint selftest: FAILED")
         for msg in failures:
@@ -502,6 +607,8 @@ def main(argv=None) -> int:
                     help="skip the SLO rule file validation")
     ap.add_argument("--skip-autoshard", action="store_true",
                     help="skip the auto-shard planner coverage check")
+    ap.add_argument("--skip-warmstore", action="store_true",
+                    help="skip the warm-store verify gate")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
@@ -577,6 +684,16 @@ def main(argv=None) -> int:
             print(f"auto-shard planner: clean "
                   f"({len(EXAMPLE_PROGRAMS)} example programs x "
                   f"{len(AUTOSHARD_MESHES)} meshes)")
+    if not args.skip_warmstore:
+        wsf = lint_warmstore()
+        for f in wsf:
+            print(f"warmstore: {f}")
+        if wsf:
+            print(f"warm store: {len(wsf)} finding(s)")
+            rc = 1
+        else:
+            print("warm store: clean (planted store verifies, "
+                  "one-byte flip caught)")
     return rc
 
 

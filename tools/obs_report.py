@@ -548,6 +548,80 @@ def render_online(events: Optional[List[dict]],
     return "\n".join(lines)
 
 
+# -------------------------------------------------------------- warmstore --
+
+def render_warmstore(events: Optional[List[dict]],
+                     snapshot: Optional[dict] = None) -> str:
+    """Warm-start store activity (paddle_tpu/warmstore/): restore hits by
+    tier, miss/quarantine causes, the tier-A probe verdict, bytes on
+    disk, and restore wall time (the seconds that would otherwise be in
+    ``executor_compile_seconds``)."""
+    lines = ["== Warm starts =="]
+    events = events or []
+    ws = [e for e in events
+          if str(e.get("event", "")).startswith("warmstore_")]
+    fams = {f.get("name"): f for f in (snapshot or {}).get("families", [])}
+    hits_t = _counter_total(snapshot, "warmstore_hits_total")
+    miss_t = _counter_total(snapshot, "warmstore_misses_total")
+    if not ws and hits_t is None and miss_t is None:
+        lines.append("idle: warm store disarmed (point PADDLE_TPU_WARMSTORE "
+                     "at a shared directory to reuse compiles across "
+                     "restarts, resizes and serving cold starts)")
+        return "\n".join(lines)
+    by_tier = {}
+    for s in fams.get("warmstore_hits_total", {}).get("samples", []):
+        t = s.get("labels", {}).get("tier", "?")
+        by_tier[t] = by_tier.get(t, 0.0) + s.get("value", 0.0)
+    by_reason = {}
+    for s in fams.get("warmstore_misses_total", {}).get("samples", []):
+        r = s.get("labels", {}).get("reason", "?")
+        by_reason[r] = by_reason.get(r, 0.0) + s.get("value", 0.0)
+    tier_part = ", ".join(f"tier {t}: {v:g}"
+                          for t, v in sorted(by_tier.items()))
+    reason_part = ", ".join(f"{r}: {v:g}"
+                            for r, v in sorted(by_reason.items()))
+    lines.append(f"restores: {hits_t or 0.0:g} "
+                 f"({tier_part or 'no tier breakdown'}); "
+                 f"misses: {miss_t or 0.0:g}"
+                 + (f" ({reason_part})" if reason_part else ""))
+    quar_t = _counter_total(snapshot, "warmstore_quarantined_total")
+    if quar_t:
+        lines.append(f"quarantined entries (.corrupt, checksum/parse "
+                     f"failures): {quar_t:g}")
+    for f in fams.get("warmstore_bytes_total", {}).get("samples", []):
+        lines.append(f"store size now: {f.get('value', 0.0):g} bytes")
+    for s in fams.get("warmstore_restore_seconds", {}).get("samples", []):
+        n = s.get("count", 0)
+        if not n:
+            continue
+        p50 = _hist_quantile(s.get("buckets", []), 0.5)
+        p99 = _hist_quantile(s.get("buckets", []), 0.99)
+        fmt = lambda v: ("?" if v is None else "inf" if math.isinf(v)
+                         else f"{v * 1e3:.4g}ms")
+        mean = s.get("sum", 0.0) / n
+        lines.append(f"restore wall (would have been compile): n={n} "
+                     f"mean={mean * 1e3:.4g}ms p50<={fmt(p50)} "
+                     f"p99<={fmt(p99)}")
+    for e in ws:
+        if e.get("event") == "warmstore_probe":
+            state = "enabled" if e.get("tier_a") else "DISABLED"
+            lines.append(f"tier A (serialized executables) {state} "
+                         f"[{e.get('source')}]: "
+                         f"{str(e.get('reason', ''))[:90]}")
+            break
+    for e in [x for x in ws if x.get("event") == "warmstore_write"][-3:]:
+        lines.append(f"  WRITE {e.get('digest')} kind={e.get('kind')} "
+                     f"{e.get('files')} ({e.get('bytes')} bytes)")
+    for e in [x for x in ws if x.get("event") == "warmstore_quarantine"][-3:]:
+        lines.append(f"  QUARANTINE {e.get('digest')} -> .corrupt "
+                     f"({str(e.get('reason', ''))[:60]}) -- fell through "
+                     f"to a fresh compile")
+    for e in [x for x in ws if x.get("event") == "warmstore_gc"][-1:]:
+        lines.append(f"  GC evicted {len(e.get('removed') or [])} "
+                     f"entries")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- megastep --
 
 def _counter_total(snapshot: Optional[dict], name: str) -> Optional[float]:
@@ -944,6 +1018,7 @@ def render_report(events: Optional[List[dict]],
         parts.append(render_serving(events, snapshot))
         parts.append(render_ingestion(events, snapshot))
         parts.append(render_online(events, snapshot))
+        parts.append(render_warmstore(events, snapshot))
         parts.append(render_alerts(events, snapshot))
     if bench_summary is not None or snapshot is not None or events:
         parts.append(render_attribution(events, snapshot, bench_summary))
@@ -1043,6 +1118,14 @@ def selftest() -> int:
     for v in (0.004, 0.006, 0.011):
         reg.histogram("online_publish_seconds").observe(v)
     reg.gauge("model_staleness_seconds").set(2.5)
+    # warm-start store sources (paddle_tpu/warmstore/, ISSUE 20)
+    reg.counter("warmstore_hits_total", tier="b").inc(2)
+    reg.counter("warmstore_misses_total", reason="absent").inc(3)
+    reg.counter("warmstore_misses_total", reason="corrupt").inc()
+    reg.counter("warmstore_quarantined_total").inc()
+    reg.gauge("warmstore_bytes_total").set(12781)
+    for v in (0.02, 0.03):
+        reg.histogram("warmstore_restore_seconds").observe(v)
     # alerts & post-mortem sources (observability/slo.py + blackbox.py)
     reg.counter("alerts_total", rule="training-goodput",
                 severity="page").inc(2)
@@ -1189,6 +1272,18 @@ def selftest() -> int:
         {"event": "postmortem", "reason": "retries_exhausted",
          "path": "postmortems/postmortem-20260806T000000Z-p1/bundle.json",
          "ts": 9.974},
+        # warm-start store section (paddle_tpu/warmstore/, ISSUE 20)
+        {"event": "warmstore_probe", "tier_a": False,
+         "reason": "jaxlib<=0.4.36 CPU executable (de)serialization "
+                   "corrupts the glibc heap",
+         "source": "denylist", "ts": 9.975},
+        {"event": "warmstore_write", "digest": "3a30af139ce5d56a",
+         "kind": "train_step", "files": ["tier_b.bin"], "bytes": 5437,
+         "ts": 9.976},
+        {"event": "warmstore_hit", "tier": "b",
+         "digest": "3a30af139ce5d56a", "kind": "train_step", "ts": 9.977},
+        {"event": "warmstore_quarantine", "digest": "89f712229c015fed",
+         "reason": "tier_b.bin checksum", "ts": 9.978},
     ]
 
     # a synthetic flight-recorder trace through the real exporter
@@ -1319,6 +1414,21 @@ def selftest() -> int:
                      "chunk 0: crc32 mismatch",
                      "publish wall: n=3",
                      "model staleness now: 2.5s",
+                     # warm-start store section (ISSUE 20)
+                     "== Warm starts ==",
+                     "restores: 2 (tier b: 2); misses: 4 (absent: 3, "
+                     "corrupt: 1)",
+                     "quarantined entries (.corrupt, checksum/parse "
+                     "failures): 1",
+                     "store size now: 12781 bytes",
+                     "restore wall (would have been compile): n=2",
+                     "tier A (serialized executables) DISABLED "
+                     "[denylist]",
+                     "WRITE 3a30af139ce5d56a kind=train_step "
+                     "['tier_b.bin'] (5437 bytes)",
+                     "QUARANTINE 89f712229c015fed -> .corrupt "
+                     "(tier_b.bin checksum) -- fell through to a fresh "
+                     "compile",
                      # alerts & post-mortem section (ISSUE 17)
                      "== Alerts & post-mortems ==",
                      "SLO engine armed: 2 rule(s) [training-goodput, "

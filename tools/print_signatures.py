@@ -39,6 +39,7 @@ MODULES = [
     "paddle_tpu.observability",
     "paddle_tpu.online",
     "paddle_tpu.serving",
+    "paddle_tpu.warmstore",
     "paddle_tpu.utils.checkpointer",
     "tools.ckpt_doctor",
 ]

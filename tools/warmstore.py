@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Launcher for the warm-start store CLI (``python -m paddle_tpu.warmstore``).
+
+    python tools/warmstore.py [--root DIR] ls
+    python tools/warmstore.py [--root DIR] verify        # rc 1 on damage
+    python tools/warmstore.py [--root DIR] gc --max-bytes N
+    python tools/warmstore.py [--root DIR] prefetch
+    python tools/warmstore.py --selftest                 # hermetic
+
+Inspect, integrity-check, size-bound, and page-cache-warm the persistent
+compiled-artifact store (``PADDLE_TPU_WARMSTORE``) that the executor,
+Predictor, and serving pool consult on compile misses.  ``verify``
+re-checksums every committed entry and exits nonzero on any damage --
+the hook ``tools/ci_lint.py`` drives over a planted store.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.warmstore.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
